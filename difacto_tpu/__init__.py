@@ -1,0 +1,11 @@
+"""difacto_tpu — a TPU-native distributed factorization-machine framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of DiFacto (distributed
+FM / l1-regularized logistic regression, parameter-server architecture):
+the server-side sparse model becomes a mesh-sharded slot table, the
+pull/compute/push round-trip becomes one fused jit step (gather -> segment-sum
+forward/backward -> scatter FTRL/AdaGrad update), and worker data parallelism
+becomes batch sharding over the mesh data axis.
+"""
+
+__version__ = "0.1.0"

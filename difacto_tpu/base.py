@@ -1,0 +1,67 @@
+"""Core types and feature-id utilities.
+
+TPU-native re-design of the reference's ``include/difacto/base.h``:
+
+- ``real_t`` -> float32 (``REAL_DTYPE``), ``feaid_t`` -> uint64 (``FEAID_DTYPE``)
+  (reference: include/difacto/base.h:16-20).
+- ``reverse_bytes`` vectorises the bit-reversal of feature ids
+  (include/difacto/base.h:39-51) over numpy uint64 arrays. The reference uses it
+  to make the key space uniform so key-range sharding across servers is
+  balanced; we use it for exactly the same reason — the slot table is sharded
+  by contiguous ranges of the *reversed* id space across the mesh feature axis.
+- feature-group id encode/decode (include/difacto/base.h:60-73).
+
+There are no DMLC_ROLE role predicates: the TPU framework is SPMD — a single
+controller drives a device mesh, so scheduler/worker/server collapse into
+(host controller, data pipeline, sharded arrays).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# value dtype used for weights/gradients on host and device
+REAL_DTYPE = np.float32
+# raw feature-id dtype (uint64, like the reference's feaid_t)
+FEAID_DTYPE = np.uint64
+
+# KWArgs in the reference is vector<pair<string,string>>; here: list of tuples.
+KWArgs = list
+
+
+def reverse_bytes(x: np.ndarray | int) -> np.ndarray | int:
+    """Reverse the nibbles of uint64 feature ids (vectorised).
+
+    Mirrors ``ReverseBytes`` in include/difacto/base.h:39-51 — a full 64-bit
+    byte+nibble reversal that makes ascending dense ids span the uint64 space
+    uniformly. Applying it twice is the identity.
+    """
+    scalar = np.isscalar(x) or (isinstance(x, np.ndarray) and x.ndim == 0)
+    x = np.asarray(x, dtype=np.uint64)
+    x = (x << np.uint64(32)) | (x >> np.uint64(32))
+    x = ((x & np.uint64(0x0000FFFF0000FFFF)) << np.uint64(16)) | \
+        ((x & np.uint64(0xFFFF0000FFFF0000)) >> np.uint64(16))
+    x = ((x & np.uint64(0x00FF00FF00FF00FF)) << np.uint64(8)) | \
+        ((x & np.uint64(0xFF00FF00FF00FF00)) >> np.uint64(8))
+    x = ((x & np.uint64(0x0F0F0F0F0F0F0F0F)) << np.uint64(4)) | \
+        ((x & np.uint64(0xF0F0F0F0F0F0F0F0)) >> np.uint64(4))
+    return x.item() if scalar else x
+
+
+def encode_fea_grp_id(x, gid: int, nbits: int):
+    """Pack a feature-group id into the low bits of a feature id.
+
+    Mirrors ``EncodeFeaGrpID`` (include/difacto/base.h:60-63).
+    """
+    if not 0 <= gid < (1 << nbits):
+        raise ValueError(f"gid {gid} out of range for {nbits} bits")
+    x = np.asarray(x, dtype=np.uint64)
+    out = (x << np.uint64(nbits)) | np.uint64(gid)
+    return out.item() if out.ndim == 0 else out
+
+
+def decode_fea_grp_id(x, nbits: int):
+    """Inverse of :func:`encode_fea_grp_id` (include/difacto/base.h:71-73)."""
+    x = np.asarray(x, dtype=np.uint64)
+    out = x & np.uint64((1 << nbits) - 1)
+    return out.item() if out.ndim == 0 else out

@@ -1,0 +1,131 @@
+"""Layered configuration system.
+
+Re-creates the reference's config chain (SURVEY §5.6): config file + CLI
+``k=v`` overrides -> flat key/value list -> each component consumes the keys it
+declares and passes the *remainder* down (reference: ``dmlc::Parameter::
+InitAllowUnknown`` + ``src/common/arg_parser.h:12-54``; the chain in
+``src/sgd/sgd_learner.cc:26-50``). Leftover keys at the end of the chain are a
+warning (src/main.cc:40-46).
+
+Usage::
+
+    @dataclass
+    class SGDLearnerParam(Param):
+        batch_size: int = field(default=100, metadata=dict(lo=1))
+        ...
+
+    param, remain = SGDLearnerParam.init_allow_unknown(kwargs)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from dataclasses import dataclass
+from typing import Any, List, Tuple
+
+log = logging.getLogger("difacto_tpu")
+
+KWArgs = List[Tuple[str, str]]
+
+
+def parse_config_file(path: str) -> KWArgs:
+    """Parse a ``key = value`` / ``key=value`` config file into KWArgs.
+
+    Mirrors dmlc::Config as used by ``ArgParser::AddArgFile``
+    (src/common/arg_parser.h:20-38): one pair per line, ``#`` comments,
+    later keys override nothing (all pairs kept; consumers take the last).
+    """
+    out: KWArgs = []
+    with open(path, "r") as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if "=" not in line:
+                raise ValueError(f"bad config line: {line!r}")
+            k, v = line.split("=", 1)
+            out.append((k.strip(), v.strip()))
+    return out
+
+
+def parse_cli_args(argv: List[str]) -> KWArgs:
+    """Parse CLI arguments: the first non ``k=v`` token is a config file."""
+    kwargs: KWArgs = []
+    for a in argv:
+        if "=" in a:
+            k, v = a.split("=", 1)
+            kwargs.append((k.strip(), v.strip()))
+        else:
+            kwargs.extend(parse_config_file(a))
+    return kwargs
+
+
+def _coerce(value: str, ty: type) -> Any:
+    if ty is bool:
+        if isinstance(value, bool):
+            return value
+        v = str(value).lower()
+        if v in ("1", "true", "yes"):
+            return True
+        if v in ("0", "false", "no"):
+            return False
+        raise ValueError(f"cannot parse bool from {value!r}")
+    return ty(value)
+
+
+@dataclass
+class Param:
+    """Base class for typed parameter structs with range checks.
+
+    Field metadata keys: ``lo``/``hi`` inclusive range bounds, ``enum`` a list
+    of allowed values — mirroring DMLC_DECLARE_FIELD's set_range/add_enum.
+    """
+
+    @classmethod
+    def init_allow_unknown(cls, kwargs: KWArgs) -> tuple["Param", KWArgs]:
+        """Consume known keys from kwargs; return (instance, remainder)."""
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        taken: dict[str, Any] = {}
+        remain: KWArgs = []
+        for k, v in kwargs:
+            f = fields.get(k)
+            if f is None:
+                remain.append((k, v))
+                continue
+            if isinstance(f.type, type):
+                ty = f.type
+            elif f.type in _FIELD_TYPES:
+                ty = _FIELD_TYPES[f.type]
+            else:
+                raise TypeError(
+                    f"{cls.__name__}.{f.name}: unsupported config field type "
+                    f"{f.type!r}; use int/float/str/bool")
+            taken[k] = _coerce(v, ty)  # last occurrence wins
+        inst = cls(**taken)
+        inst._validate()
+        return inst, remain
+
+    def _validate(self) -> None:
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            lo = f.metadata.get("lo")
+            hi = f.metadata.get("hi")
+            enum = f.metadata.get("enum")
+            if lo is not None and v < lo:
+                raise ValueError(f"{f.name}={v} < {lo}")
+            if hi is not None and v > hi:
+                raise ValueError(f"{f.name}={v} > {hi}")
+            if enum is not None and v not in enum:
+                raise ValueError(f"{f.name}={v!r} not in {enum}")
+
+
+# dataclass stores string annotations when `from __future__ import annotations`
+# is active in the defining module; map the common ones back to types.
+_FIELD_TYPES = {"int": int, "float": float, "str": str, "bool": bool}
+
+
+def warn_unknown(remain: KWArgs) -> None:
+    """Log unconsumed keys at the end of the config chain (src/main.cc:40-46)."""
+    for k, v in remain:
+        log.warning("unknown config key: %s = %s", k, v)

@@ -1,0 +1,10 @@
+from .rowblock import RowBlock, RowBlockBuilder, empty_block
+from .reader import Reader, expand_uri
+from .batch_reader import BatchReader
+from .localizer import compact
+from .rec import RecWriter, read_rec_block, write_rec_block
+
+__all__ = [
+    "RowBlock", "RowBlockBuilder", "empty_block", "Reader", "expand_uri",
+    "BatchReader", "compact", "RecWriter", "read_rec_block", "write_rec_block",
+]

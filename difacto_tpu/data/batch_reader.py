@@ -1,0 +1,67 @@
+"""Minibatching with shuffle buffer and negative downsampling.
+
+Equivalent of the reference's ``BatchReader`` (src/reader/batch_reader.{h,cc}):
+
+- fixed ``batch_size`` batches over an underlying :class:`Reader`
+  (batch_reader.cc:29-69); the final batch may be short;
+- ``shuffle`` > 0 builds a buffer of ``batch_size * shuffle`` rows and emits a
+  random permutation of it (batch_reader.cc:18-27,37-46);
+- ``neg_sampling`` < 1 keeps negatives with that probability, positives always
+  (batch_reader.cc:55-64);
+- all-ones value arrays are dropped to the binary representation
+  (batch_reader.cc:71-73).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from .reader import Reader
+from .rowblock import RowBlock, RowBlockBuilder
+
+
+class BatchReader:
+    def __init__(self, uri: str, data_format: str = "libsvm",
+                 part_idx: int = 0, num_parts: int = 1,
+                 batch_size: int = 100, shuffle_buf_size: int = 0,
+                 neg_sampling: float = 1.0, seed: int = 0,
+                 chunk_bytes: int = 1 << 26):
+        if shuffle_buf_size:
+            if shuffle_buf_size < batch_size:
+                raise ValueError("shuffle buffer must be >= batch_size")
+            # a BatchReader of the buffer size feeds the shuffler, like the
+            # recursive construction in batch_reader.cc:18-22
+            self._src: BatchReader | Reader = BatchReader(
+                uri, data_format, part_idx, num_parts,
+                batch_size=shuffle_buf_size, chunk_bytes=chunk_bytes)
+        else:
+            self._src = Reader(uri, data_format, part_idx, num_parts,
+                               chunk_bytes)
+        self.batch_size = batch_size
+        self.shuffle_buf_size = shuffle_buf_size
+        self.neg_sampling = neg_sampling
+        self._rng = np.random.RandomState(seed)
+
+    def __iter__(self) -> Iterator[RowBlock]:
+        builder = RowBlockBuilder()
+        for blk in self._src:
+            rows = np.arange(blk.size)
+            if self.shuffle_buf_size:
+                self._rng.shuffle(rows)
+            if self.neg_sampling < 1.0:
+                keep = (blk.label[rows] > 0) | (
+                    self._rng.random_sample(len(rows)) < self.neg_sampling)
+                rows = rows[keep]
+            start = 0
+            while start < len(rows):
+                take = min(self.batch_size - builder.num_rows,
+                           len(rows) - start)
+                builder.push_rows(blk, rows[start:start + take])
+                start += take
+                if builder.num_rows >= self.batch_size:
+                    yield builder.build().drop_binary_values()
+                    builder.clear()
+        if builder.num_rows:
+            yield builder.build().drop_binary_values()

@@ -1,0 +1,53 @@
+"""Feature-id localization: per-batch compaction of sparse ids.
+
+Equivalent of the reference's ``Localizer`` (src/data/localizer.{h,cc}): map a
+batch's raw uint64 feature ids to a dense [0, n) range, producing
+
+- ``uniq_ids``: the batch's distinct *reversed* feature ids, sorted ascending
+  — exactly the KV keys the reference sends to servers (localizer.cc:22-29
+  applies ReverseBytes before sorting, so the sorted dictionary is in
+  reversed-id order; ps-lite requires sorted keys, kvstore_dist.h:95);
+- optional per-id occurrence counts (for the epoch-0 kFeaCount push);
+- a compacted RowBlock whose ``index`` is uint32 positions into ``uniq_ids``.
+
+On TPU this is the boundary between the host pipeline and the device: the
+compact CSR plus ``uniq_ids`` become the gather/scatter indices of the fused
+train step — localization *is* the "pull request construction".
+
+``np.unique(return_inverse, return_counts)`` replaces the sort+scan
+(localizer.cc:22-50) and ``RemapIndex`` (localizer.cc:53-107) in one shot.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..base import reverse_bytes
+from .rowblock import RowBlock
+
+
+def compact(blk: RowBlock, need_counts: bool = False,
+            max_index_bits: int = -1
+            ) -> Tuple[RowBlock, np.ndarray, Optional[np.ndarray]]:
+    """Localize a row block.
+
+    Returns (compacted block, uniq reversed ids sorted asc, counts or None).
+    ``max_index_bits`` >= 0 masks ids to that many bits first (the reference's
+    ``max_index_`` modulo, localizer.cc:24).
+    """
+    ids = blk.index
+    if max_index_bits >= 0 and max_index_bits < 64:
+        ids = ids & np.uint64((1 << max_index_bits) - 1)
+    rev = reverse_bytes(ids)
+    uniq, inverse, counts = np.unique(rev, return_inverse=True,
+                                      return_counts=True)
+    out = RowBlock(
+        offset=blk.offset.copy(),
+        label=blk.label,
+        index=inverse.astype(np.uint32),
+        value=blk.value,
+        weight=blk.weight,
+    )
+    return out, uniq, (counts.astype(np.float32) if need_counts else None)

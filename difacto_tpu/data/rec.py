@@ -1,0 +1,97 @@
+"""Binary row-block cache — the `.rec` equivalent.
+
+The reference's fast path is recordio files of LZ4-compressed CSR blocks
+(src/reader/crb_parser.h:16-47, src/data/compressed_row_block.h:20-142),
+produced by ``task=convert`` (src/reader/converter.h:41-124). Feeding TPU
+chips from text on a single-core host is hopeless, so the same design carries
+over: parse text once, write compressed binary shards, stream those.
+
+Format: a ``<name>.rec`` directory (or explicit file list) of ``.npz``
+members, one compressed CSR block each, arrays: offset/label/index[/value]
+[/weight]. Sharding for (part_idx, num_parts) is by whole members, weighted
+by compressed size — the unit of work-stealing, like recordio parts.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Iterator, List
+
+import numpy as np
+
+from .rowblock import RowBlock
+
+
+def write_rec_block(path: str, blk: RowBlock, compress: bool = True) -> None:
+    save = np.savez_compressed if compress else np.savez
+    arrays = dict(offset=blk.offset, label=blk.label, index=blk.index)
+    if blk.value is not None:
+        arrays["value"] = blk.value
+    if blk.weight is not None:
+        arrays["weight"] = blk.weight
+    buf = io.BytesIO()
+    save(buf, **arrays)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)
+
+
+def read_rec_block(path: str) -> RowBlock:
+    with np.load(path) as z:
+        return RowBlock(
+            offset=z["offset"],
+            label=z["label"],
+            index=z["index"],
+            value=z["value"] if "value" in z.files else None,
+            weight=z["weight"] if "weight" in z.files else None,
+        )
+
+
+def rec_members(files: List[str]) -> List[str]:
+    """Resolve to .npz members only — stray files (.tmp from an interrupted
+    writer, READMEs) in a cache dir must not reach np.load."""
+    out: List[str] = []
+    for f in files:
+        if os.path.isdir(f):
+            out.extend(os.path.join(f, m) for m in sorted(os.listdir(f))
+                       if m.endswith(".npz"))
+        elif f.endswith(".npz"):
+            out.append(f)
+    return out
+
+
+def iter_rec_blocks(files: List[str], part_idx: int, num_parts: int
+                    ) -> Iterator[RowBlock]:
+    """Yield this part's members, sharded by cumulative compressed size."""
+    members = rec_members(files)
+    sizes = [os.path.getsize(m) for m in members]
+    total = sum(sizes)
+    begin = total * part_idx // num_parts
+    end = total * (part_idx + 1) // num_parts
+    base = 0
+    for m, sz in zip(members, sizes):
+        # a member belongs to the part containing its start byte
+        if begin <= base < end:
+            yield read_rec_block(m)
+        base += sz
+
+
+class RecWriter:
+    """Write a stream of RowBlocks into a .rec directory of npz shards."""
+
+    def __init__(self, out_dir: str, compress: bool = True):
+        self.out_dir = out_dir
+        self.compress = compress
+        self._n = 0
+        os.makedirs(out_dir, exist_ok=True)
+
+    def write(self, blk: RowBlock) -> None:
+        path = os.path.join(self.out_dir, f"part-{self._n:05d}.npz")
+        write_rec_block(path, blk, self.compress)
+        self._n += 1
+
+    @property
+    def num_blocks(self) -> int:
+        return self._n

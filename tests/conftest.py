@@ -1,0 +1,25 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding correctness is validated
+on host-platform virtual devices (the driver separately dry-runs the
+multi-chip path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pathlib
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rcv1_path() -> str:
+    """First 100 rows of the public rcv1.binary dataset (libsvm format) —
+    the same fixture the reference's golden tests use (tests/README.md)."""
+    return str(pathlib.Path(__file__).parent / "data" / "rcv1_100.libsvm")

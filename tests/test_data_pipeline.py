@@ -1,0 +1,172 @@
+"""Data pipeline tests — analogs of the reference's batch_reader_test.cc,
+localizer_test.cc, and data-format roundtrips."""
+
+import numpy as np
+import pytest
+
+from difacto_tpu.base import reverse_bytes, encode_fea_grp_id, decode_fea_grp_id
+from difacto_tpu.config import Param, parse_cli_args, parse_config_file
+from difacto_tpu.data import (BatchReader, Reader, RecWriter, RowBlock,
+                              compact, read_rec_block)
+from difacto_tpu.data.parsers import parse_adfea, parse_criteo, parse_libsvm
+
+
+def load_all(uri, **kw):
+    blocks = list(Reader(uri, "libsvm", **kw))
+    return RowBlock.concat(blocks) if blocks else None
+
+
+def test_parse_libsvm_fixture(rcv1_path):
+    blk = load_all(rcv1_path)
+    assert blk.size == 100
+    assert blk.nnz == int(blk.offset[-1])
+    assert blk.index.max() <= 47149  # fixture property (tests/README.md)
+    assert set(np.unique(blk.label)) <= {0.0, 1.0, -1.0}
+    # spot-check the first row's first entry: "1 440:0.033906..."
+    assert blk.label[0] == 1.0
+    assert blk.index[0] == 440
+    np.testing.assert_allclose(blk.value[0], 0.033906222568727, rtol=1e-6)
+
+
+def test_reader_sharding_partition(rcv1_path):
+    """Each row appears in exactly one part (InputSplit contract)."""
+    whole = load_all(rcv1_path)
+    rows = []
+    for p in range(4):
+        blk = load_all(rcv1_path, part_idx=p, num_parts=4)
+        if blk is not None:
+            rows.append(blk)
+    merged = RowBlock.concat(rows)
+    assert merged.size == whole.size
+    assert merged.nnz == whole.nnz
+    # parts are contiguous line ranges, so concatenation in part order
+    # reproduces the file exactly
+    np.testing.assert_array_equal(merged.label, whole.label)
+    np.testing.assert_array_equal(merged.index, whole.index)
+
+
+def test_reader_small_chunks_equal_one_chunk(rcv1_path):
+    a = load_all(rcv1_path)
+    b = load_all(rcv1_path, chunk_bytes=1000)
+    np.testing.assert_array_equal(a.offset, b.offset)
+    np.testing.assert_array_equal(a.index, b.index)
+    np.testing.assert_array_equal(a.value, b.value)
+
+
+def test_batch_reader_exact_boundaries(rcv1_path):
+    sizes = [b.size for b in BatchReader(rcv1_path, batch_size=32)]
+    assert sizes == [32, 32, 32, 4]
+
+
+def test_batch_reader_shuffle_preserves_multiset(rcv1_path):
+    plain = RowBlock.concat(list(BatchReader(rcv1_path, batch_size=100)))
+    shuf = RowBlock.concat(list(
+        BatchReader(rcv1_path, batch_size=10, shuffle_buf_size=50, seed=3)))
+    assert shuf.size == plain.size
+    assert shuf.nnz == plain.nnz
+    # per-row nnz multiset invariant under permutation
+    assert sorted(np.diff(shuf.offset)) == sorted(np.diff(plain.offset))
+    assert np.sort(shuf.label).tolist() == np.sort(plain.label).tolist()
+
+
+def test_batch_reader_neg_sampling(rcv1_path):
+    full = RowBlock.concat(list(BatchReader(rcv1_path, batch_size=100)))
+    sub = RowBlock.concat(list(
+        BatchReader(rcv1_path, batch_size=100, neg_sampling=0.3, seed=1)))
+    n_pos = int((full.label > 0).sum())
+    assert int((sub.label > 0).sum()) == n_pos  # positives always kept
+    assert int((sub.label <= 0).sum()) < int((full.label <= 0).sum())
+
+
+def test_reverse_bytes_involution():
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 2**63, size=1000).astype(np.uint64)
+    np.testing.assert_array_equal(reverse_bytes(reverse_bytes(x)), x)
+    assert reverse_bytes(reverse_bytes(12345)) == 12345
+
+
+def test_fea_grp_id_roundtrip():
+    assert decode_fea_grp_id(encode_fea_grp_id(98765, 11, 12), 12) == 11
+
+
+def test_localizer_compact(rcv1_path):
+    blk = load_all(rcv1_path)
+    out, uniq, cnt = compact(blk, need_counts=True)
+    assert (np.diff(uniq.astype(np.int64) if uniq.max() < 2**63 else uniq)
+            > 0).all() or len(uniq) == 1  # sorted strictly ascending
+    assert out.index.max() == len(uniq) - 1
+    # remapping is consistent: reversed original id == uniq[compact index]
+    np.testing.assert_array_equal(uniq[out.index], reverse_bytes(blk.index))
+    # counts sum to nnz
+    assert int(cnt.sum()) == blk.nnz
+    # brute-force count check on a few ids
+    rev = reverse_bytes(blk.index)
+    for i in [0, len(uniq) // 2, len(uniq) - 1]:
+        assert cnt[i] == (rev == uniq[i]).sum()
+
+
+def test_rec_roundtrip(rcv1_path, tmp_path):
+    blk = load_all(rcv1_path)
+    w = RecWriter(str(tmp_path / "data.rec"))
+    for b in BatchReader(rcv1_path, batch_size=40):
+        w.write(b)
+    assert w.num_blocks == 3
+    back = RowBlock.concat(list(Reader(str(tmp_path / "data.rec"), "rec")))
+    np.testing.assert_array_equal(back.offset, blk.offset)
+    np.testing.assert_array_equal(back.index, blk.index)
+    np.testing.assert_allclose(back.value, blk.value)
+    # rec sharding partitions members across parts
+    tot = sum(b.size for p in range(2)
+              for b in Reader(str(tmp_path / "data.rec"), "rec", p, 2))
+    assert tot == 100
+
+
+def test_parse_criteo():
+    ints1 = [b"3", b""] + [b"5"] + [b""] * 10      # 13 integer columns
+    ints2 = [b"", b"7"] + [b""] * 11
+    row1 = b"\t".join([b"1"] + ints1 + [b"deadbeef", b"cafe0123"])
+    row2 = b"\t".join([b"0"] + ints2 + [b"deadbeef"])
+    chunk = row1 + b"\n" + row2 + b"\n"
+    blk = parse_criteo(chunk)
+    assert blk.size == 2
+    assert blk.label.tolist() == [1.0, 0.0]
+    assert np.diff(blk.offset).tolist() == [4, 2]
+    # group ids live in the low 12 bits
+    gids = (blk.index & np.uint64(4095)).astype(int)
+    assert gids.tolist() == [0, 2, 13, 14, 1, 13]
+    # same token+column hashes identically across rows
+    assert blk.index[2] == blk.index[5]
+
+
+def test_parse_adfea():
+    chunk = b"100 2 1 5:1 7:2\n101 3 0 9:1\n"
+    blk = parse_adfea(chunk)
+    assert blk.size == 2
+    assert blk.label.tolist() == [1.0, 0.0]
+    assert np.diff(blk.offset).tolist() == [2, 1]
+    assert decode_fea_grp_id(int(blk.index[0]), 12) == 1
+    assert int(blk.index[0]) >> 12 == 5
+
+
+def test_config_chain(tmp_path):
+    from dataclasses import dataclass, field
+
+    @dataclass
+    class P1(Param):
+        lr: float = field(default=0.01, metadata=dict(lo=0))
+        batch_size: int = 100
+
+    @dataclass
+    class P2(Param):
+        l1: float = 1.0
+
+    conf = tmp_path / "c.conf"
+    conf.write_text("lr = 0.5\n# comment\nl1 = 4\n")
+    kwargs = parse_cli_args([str(conf), "batch_size=32"])
+    p1, remain = P1.init_allow_unknown(kwargs)
+    assert p1.lr == 0.5 and p1.batch_size == 32
+    p2, remain = P2.init_allow_unknown(remain)
+    assert p2.l1 == 4.0
+    assert remain == []
+    with pytest.raises(ValueError):
+        P1.init_allow_unknown([("lr", "-1")])
