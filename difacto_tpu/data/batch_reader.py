@@ -6,8 +6,11 @@ Equivalent of the reference's ``BatchReader`` (src/reader/batch_reader.{h,cc}):
   (batch_reader.cc:29-69); the final batch may be short;
 - ``shuffle`` > 0 builds a buffer of ``batch_size * shuffle`` rows and emits a
   random permutation of it (batch_reader.cc:18-27,37-46);
-- ``neg_sampling`` < 1 keeps negatives with that probability, positives always
-  (batch_reader.cc:55-64);
+- ``neg_sampling`` < 1 *drops* each negative row with probability
+  ``neg_sampling`` (positives always kept). Counter-intuitive but exactly the
+  reference's arithmetic: it skips a negative when ``p > 1 - neg_sampling``
+  (batch_reader.cc:58-64), i.e. keep probability is ``1 - neg_sampling``;
+  ``neg_sampling == 1.0`` disables sampling entirely (the ``< 1.0`` gate);
 - all-ones value arrays are dropped to the binary representation
   (batch_reader.cc:71-73).
 """
@@ -51,8 +54,10 @@ class BatchReader:
             if self.shuffle_buf_size:
                 self._rng.shuffle(rows)
             if self.neg_sampling < 1.0:
+                # keep a negative iff p <= 1 - neg_sampling (batch_reader.cc:58-64)
                 keep = (blk.label[rows] > 0) | (
-                    self._rng.random_sample(len(rows)) < self.neg_sampling)
+                    self._rng.random_sample(len(rows))
+                    <= 1.0 - self.neg_sampling)
                 rows = rows[keep]
             start = 0
             while start < len(rows):

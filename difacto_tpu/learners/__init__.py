@@ -1,0 +1,4 @@
+from .base import Learner, register
+from .sgd import SGDLearner, SGDLearnerParam
+
+__all__ = ["Learner", "register", "SGDLearner", "SGDLearnerParam"]

@@ -1,0 +1,56 @@
+"""Learner base + factory.
+
+Equivalent of include/difacto/learner.h / src/learner.cc. The reference's
+``Run()`` dispatches on DMLC_ROLE (scheduler drives, workers/servers block in
+tracker Wait); in the SPMD design there is one controller, so ``run()`` just
+drives the epoch loop — the "roles" are the host pipeline (worker), the
+device slot table (server), and this loop (scheduler).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from ..config import KWArgs
+from ..utils.progress import Progress
+
+EpochCallback = Callable[[int, Progress, Progress], None]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+class Learner:
+    """Base learner: init(kwargs) -> run() -> stop()."""
+
+    def __init__(self) -> None:
+        self.epoch_end_callbacks: List[EpochCallback] = []
+
+    @staticmethod
+    def create(name: str) -> "Learner":
+        # the reference factory registers only "sgd" (src/learner.cc:11-18);
+        # we register every learner we implement
+        try:
+            cls = _REGISTRY[name.lower()]
+        except KeyError:
+            raise ValueError(
+                f"unknown learner {name!r}; have {sorted(_REGISTRY)}")
+        return cls()
+
+    def init(self, kwargs: KWArgs) -> KWArgs:
+        raise NotImplementedError
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        pass
+
+    def add_epoch_end_callback(self, cb: EpochCallback) -> None:
+        self.epoch_end_callbacks.append(cb)

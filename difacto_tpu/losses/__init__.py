@@ -1,0 +1,41 @@
+"""Loss factory — parity with Loss::Create (src/loss/loss.cc:13-26).
+
+``create("fm" | "logit", V_dim)`` returns a thin namespace over the pure
+kernels in fm.py; "logit" forces V_dim = 0 (src/loss/logit_loss.h is the
+linear special case).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .fm import FMParams, fm_grad, fm_predict, logit_objv
+from . import metrics
+
+
+@dataclass(frozen=True)
+class LossSpec:
+    name: str
+    V_dim: int
+
+    def predict(self, params: FMParams, batch):
+        return fm_predict(params, batch)
+
+    def calc_grad(self, params: FMParams, batch, pred):
+        return fm_grad(params, batch, pred)
+
+    def evaluate(self, pred, batch):
+        return logit_objv(pred, batch)
+
+
+def create(name: str, V_dim: int = 0) -> LossSpec:
+    name = name.lower()
+    if name == "logit":
+        return LossSpec("logit", 0)
+    if name == "fm":
+        return LossSpec("fm", V_dim)
+    raise ValueError(f"unknown loss type: {name!r}")
+
+
+__all__ = ["FMParams", "fm_predict", "fm_grad", "logit_objv", "LossSpec",
+           "create", "metrics"]

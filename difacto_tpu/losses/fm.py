@@ -1,0 +1,93 @@
+"""Factorization-machine and logistic losses as pure jit kernels.
+
+Re-derivation of the reference's FMLoss (src/loss/fm_loss.h) in gathered-row
+form. The loss receives the batch's *already-gathered* parameter rows — w[U]
+and V[U, k] for the batch's U distinct features — mirroring the reference
+contract where the loss consumes pulled weight vectors, but with the
+variable-length [w, V...] byte layout (fm_loss.h:51-53, sgd_learner.cc:151-165)
+replaced by fixed (U,) + (U, k) arrays plus an activation mask ``v_mask``
+(1.0 where the reference would have V_pos >= 0, i.e. the embedding exists and
+is not l1-shrunk away).
+
+Forward (fm_loss.h:43,67-119):
+    pred = X w + 0.5 * sum((X V)^2 - (X.X)(V.V), axis=1), clamped to [-20, 20]
+
+Backward (fm_loss.h:124-126,148-203), with p = -y / (1 + exp(y pred)) * rw:
+    gw = X' p
+    gV = X' diag(p) X V - diag((X.X)' p) V        (masked by v_mask)
+
+Logistic loss (src/loss/logit_loss.h) is the V_dim=0 special case — same code
+path with V=None.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..ops.batch import DeviceBatch
+from ..ops.segment import spmm, spmm_t, spmv, spmv_t
+
+PRED_CLAMP = 20.0
+
+
+class FMParams(NamedTuple):
+    """Gathered per-batch parameter rows."""
+    w: jnp.ndarray                     # f32[U]
+    V: Optional[jnp.ndarray] = None    # f32[U, k] or None (pure LR)
+    v_mask: Optional[jnp.ndarray] = None  # f32[U]; None == all active
+
+
+def _vmask(params: FMParams) -> jnp.ndarray:
+    if params.v_mask is None:
+        return jnp.ones_like(params.w)
+    return params.v_mask
+
+
+def fm_predict(params: FMParams, batch: DeviceBatch) -> jnp.ndarray:
+    """pred[B]; padding rows produce garbage — mask at use sites."""
+    B = batch.batch_cap
+    pred = spmv(batch.vals, batch.rows, batch.cols, params.w, B)
+    if params.V is not None and params.V.shape[1] > 0:
+        Vm = params.V * _vmask(params)[:, None]
+        XV = spmm(batch.vals, batch.rows, batch.cols, Vm, B)
+        XXVV = spmm(batch.vals ** 2, batch.rows, batch.cols, Vm ** 2, B)
+        pred = pred + 0.5 * jnp.sum(XV ** 2 - XXVV, axis=1)
+    return jnp.clip(pred, -PRED_CLAMP, PRED_CLAMP)
+
+
+def _p_vector(pred: jnp.ndarray, batch: DeviceBatch) -> jnp.ndarray:
+    """p = -y/(1+exp(y*pred)) * row_weight, zeroed on padding rows."""
+    y = jnp.where(batch.labels > 0, 1.0, -1.0)
+    p = -y / (1.0 + jnp.exp(y * pred))
+    return p * batch.rweight * batch.row_mask
+
+
+def fm_grad(params: FMParams, batch: DeviceBatch, pred: jnp.ndarray
+            ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Returns (gw[U], gV[U,k] or None)."""
+    U = params.w.shape[0]
+    p = _p_vector(pred, batch)
+    gw = spmv_t(batch.vals, batch.rows, batch.cols, p, U)
+    if params.V is None or params.V.shape[1] == 0:
+        return gw, None
+    vm = _vmask(params)
+    Vm = params.V * vm[:, None]
+    XV = spmm(batch.vals, batch.rows, batch.cols, Vm, batch.batch_cap)
+    # X' diag(p) X V
+    t1 = spmm_t(batch.vals, batch.rows, batch.cols, p[:, None] * XV, U)
+    # diag((X.X)'p) V
+    xxp = spmv_t(batch.vals ** 2, batch.rows, batch.cols, p, U)
+    gV = (t1 - xxp[:, None] * Vm) * vm[:, None]
+    return gw, gV
+
+
+def logit_objv(pred: jnp.ndarray, batch: DeviceBatch) -> jnp.ndarray:
+    """sum log(1 + exp(-y*pred)) over real rows (include/difacto/loss.h:57-66).
+
+    Not averaged — the reference accumulates raw sums and lets the progress
+    printer divide (sgd_utils.h:100-109)."""
+    y = jnp.where(batch.labels > 0, 1.0, -1.0)
+    per_row = jnp.log1p(jnp.exp(-y * pred))
+    return jnp.sum(per_row * batch.row_mask)

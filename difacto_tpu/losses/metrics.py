@@ -1,0 +1,78 @@
+"""Binary classification metrics.
+
+Equivalent of the reference's BinClassMetric (src/loss/bin_class_metric.h),
+keeping its exact conventions: metrics are *not* divided by num_examples
+(progress merging sums them across jobs and the printer divides); AUC returns
+area * n with the < 0.5 flip (bin_class_metric.h:35-57).
+
+Two implementations: numpy (host, for per-batch progress) and jnp (device,
+usable inside jit — sort-based, identical semantics).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def auc_times_n(label: np.ndarray, pred: np.ndarray) -> float:
+    """Rank-sum AUC scaled by n (bin_class_metric.h:35-57)."""
+    n = len(label)
+    if n == 0:
+        return 0.0
+    order = np.argsort(pred, kind="stable")
+    lab = label[order] > 0
+    cum_tp = np.cumsum(lab)
+    npos = cum_tp[-1]
+    if npos == 0 or npos == n:
+        return 1.0
+    area = float(cum_tp[~lab].sum())
+    area /= npos * (n - npos)
+    return (1.0 - area if area < 0.5 else area) * n
+
+
+def auc_times_n_jnp(label: jnp.ndarray, pred: jnp.ndarray,
+                    mask: jnp.ndarray) -> jnp.ndarray:
+    """Device AUC over masked rows; padding rows must have mask==0.
+
+    Padding is sorted to the end (pred := +inf on pads) and excluded from the
+    cumulative counts, so the result matches the numpy version on real rows.
+    """
+    big = jnp.asarray(jnp.inf, pred.dtype)
+    key = jnp.where(mask > 0, pred, big)
+    order = jnp.argsort(key)
+    lab = (label[order] > 0) & (mask[order] > 0)
+    neg = (label[order] <= 0) & (mask[order] > 0)
+    cum_tp = jnp.cumsum(lab)
+    npos = cum_tp[-1]
+    n = jnp.sum(mask)
+    nneg = n - npos
+    area = jnp.sum(jnp.where(neg, cum_tp, 0.0))
+    area = area / jnp.maximum(npos * nneg, 1)
+    area = jnp.where(area < 0.5, 1.0 - area, area) * n
+    return jnp.where((npos == 0) | (nneg == 0), 1.0, area)
+
+
+def accuracy_times_n(label: np.ndarray, pred: np.ndarray,
+                     threshold: float = 0.0) -> float:
+    correct = float(np.sum((label > 0) == (pred > threshold)))
+    n = len(label)
+    return correct if correct > 0.5 * n else n - correct
+
+
+def logloss(label: np.ndarray, pred: np.ndarray) -> float:
+    y = (label > 0).astype(np.float64)
+    p = 1.0 / (1.0 + np.exp(-pred.astype(np.float64)))
+    p = np.clip(p, 1e-10, 1.0)
+    return float(-np.sum(y * np.log(p) + (1 - y) * np.log1p(-p)))
+
+
+def logit_objv_np(label: np.ndarray, pred: np.ndarray) -> float:
+    y = np.where(label > 0, 1.0, -1.0)
+    return float(np.sum(np.log1p(np.exp(-y * pred.astype(np.float64)))))
+
+
+def rmse_stub(label: np.ndarray, pred: np.ndarray) -> float:
+    """Reference's RMSE sums raw differences (bin_class_metric.h:94-102) —
+    kept name-for-name; use logloss/auc for real evaluation."""
+    return float(np.sum(label - pred))

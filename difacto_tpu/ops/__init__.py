@@ -1,0 +1,5 @@
+from .batch import DeviceBatch, bucket, pad_batch
+from .segment import spmm, spmm_t, spmv, spmv_t
+
+__all__ = ["DeviceBatch", "bucket", "pad_batch",
+           "spmm", "spmm_t", "spmv", "spmv_t"]

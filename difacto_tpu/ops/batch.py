@@ -1,0 +1,91 @@
+"""Device batch representation: padded COO segments.
+
+The bridge between the host CSR pipeline and XLA's static-shape world. A
+localized row block (data/localizer.py) becomes a :class:`DeviceBatch` of
+fixed-bucket-size arrays:
+
+- ``rows[NNZ]`` int32 segment ids, ``cols[NNZ]`` int32 local feature slots,
+  ``vals[NNZ]`` float32 (zero on padding — padded entries contribute nothing
+  to any segment sum);
+- ``labels/rweight/row_mask [B]`` per-row arrays.
+
+Bucketing pads NNZ, U (distinct features) and B (rows) up to the next
+power-of-two-ish bucket so jit recompiles only per bucket, not per batch —
+this is the TPU answer to the reference's fully dynamic per-batch shapes
+(its SArray messages can be any length; XLA cannot).
+
+The reference analog of this file is the implicit contract between
+Localizer's compact CSR and the SpMV/SpMM kernels (src/common/spmv.h:16-40).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import REAL_DTYPE
+from ..data.rowblock import RowBlock
+
+
+class DeviceBatch(NamedTuple):
+    """Padded COO batch; all leaves are jnp arrays, shapes static per bucket."""
+    rows: jnp.ndarray      # int32[NNZ] row of each nonzero (pad: last real row)
+    cols: jnp.ndarray      # int32[U-index] of each nonzero (pad: 0)
+    vals: jnp.ndarray      # f32[NNZ] (pad: 0)
+    labels: jnp.ndarray    # f32[B]
+    rweight: jnp.ndarray   # f32[B] per-row example weights (pad: 0)
+    row_mask: jnp.ndarray  # f32[B] 1 for real rows
+    num_rows: jnp.ndarray  # i32[] actual batch size
+    num_uniq: jnp.ndarray  # i32[] actual distinct-feature count
+
+    @property
+    def batch_cap(self) -> int:
+        return self.labels.shape[0]
+
+    @property
+    def nnz_cap(self) -> int:
+        return self.vals.shape[0]
+
+
+def bucket(n: int, minimum: int = 8) -> int:
+    """Round up to the next power of two (>= minimum)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_batch(blk: RowBlock, num_uniq: int,
+              batch_cap: Optional[int] = None,
+              nnz_cap: Optional[int] = None) -> DeviceBatch:
+    """Pack a *localized* row block (uint32 indices) into a DeviceBatch."""
+    b, nnz = blk.size, blk.nnz
+    bc = batch_cap or bucket(b)
+    nc = nnz_cap or bucket(nnz)
+    if b > bc or nnz > nc:
+        raise ValueError(f"batch ({b},{nnz}) exceeds caps ({bc},{nc})")
+
+    rows = np.zeros(nc, dtype=np.int32)
+    rows[:nnz] = blk.row_ids()
+    rows[nnz:] = max(b - 1, 0)  # pad rows point at a real segment; vals=0
+    cols = np.zeros(nc, dtype=np.int32)
+    cols[:nnz] = blk.index.astype(np.int32)
+    vals = np.zeros(nc, dtype=REAL_DTYPE)
+    vals[:nnz] = blk.values_or_ones()
+
+    labels = np.zeros(bc, dtype=REAL_DTYPE)
+    labels[:b] = blk.label
+    rweight = np.zeros(bc, dtype=REAL_DTYPE)
+    rweight[:b] = blk.weight if blk.weight is not None else 1.0
+    row_mask = np.zeros(bc, dtype=REAL_DTYPE)
+    row_mask[:b] = 1.0
+
+    return DeviceBatch(
+        rows=jnp.asarray(rows), cols=jnp.asarray(cols), vals=jnp.asarray(vals),
+        labels=jnp.asarray(labels), rweight=jnp.asarray(rweight),
+        row_mask=jnp.asarray(row_mask),
+        num_rows=jnp.asarray(b, dtype=jnp.int32),
+        num_uniq=jnp.asarray(num_uniq, dtype=jnp.int32),
+    )

@@ -1,0 +1,44 @@
+"""Sparse matrix kernels as segment reductions.
+
+TPU-native replacements for the reference's OpenMP CSR kernels:
+
+- ``spmv`` / ``spmv_t``   <- SpMV::Times / TransTimes (src/common/spmv.h:16-203)
+- ``spmm`` / ``spmm_t``   <- SpMM::Times / TransTimes (src/common/spmm.h:19-181)
+
+The reference threads over row/column ranges; on TPU the same contractions are
+``jax.ops.segment_sum`` over the COO expansion, which XLA lowers to sorted
+scatter-adds and fuses with the surrounding elementwise work. The position-
+indirection variants (pos[i] == -1 meaning "absent", spmv.h:60-100) become
+multiplicative masks — absent rows carry zero weight and masked gradients —
+see losses/fm.py's ``v_mask``.
+
+All kernels are shape-static (COO padded by ops/batch.py; padding has val=0 so
+it contributes nothing to any segment).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def spmv(vals, rows, cols, x, num_rows: int):
+    """y[r] = sum_k vals[k] * x[cols[k]] over nonzeros with rows[k]==r."""
+    return jax.ops.segment_sum(vals * x[cols], rows, num_segments=num_rows)
+
+
+def spmv_t(vals, rows, cols, p, num_cols: int):
+    """y[c] = sum_k vals[k] * p[rows[k]] — the transpose product."""
+    return jax.ops.segment_sum(vals * p[rows], cols, num_segments=num_cols)
+
+
+def spmm(vals, rows, cols, X, num_rows: int):
+    """Y[r, :] = sum_k vals[k] * X[cols[k], :] for an (U, k) dense rhs."""
+    return jax.ops.segment_sum(vals[:, None] * X[cols], rows,
+                               num_segments=num_rows)
+
+
+def spmm_t(vals, rows, cols, P, num_cols: int):
+    """Y[c, :] = sum_k vals[k] * P[rows[k], :]."""
+    return jax.ops.segment_sum(vals[:, None] * P[rows], cols,
+                               num_segments=num_cols)

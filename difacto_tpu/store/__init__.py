@@ -1,0 +1,3 @@
+from .local import K_FEACOUNT, K_GRADIENT, K_WEIGHT, SlotStore
+
+__all__ = ["SlotStore", "K_FEACOUNT", "K_WEIGHT", "K_GRADIENT"]

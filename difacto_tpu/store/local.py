@@ -1,0 +1,211 @@
+"""Slot store: host feature dictionary + device slot table.
+
+This is the TPU-native "parameter server". The reference's Store
+(include/difacto/store.h) routes Push/Pull KV messages to server-side
+updaters; here the model lives in device arrays and the host keeps only the
+feature-id -> slot mapping:
+
+- ``map_keys(uniq_ids)``: bulk lookup-or-insert of a batch's sorted unique
+  (byte-reversed) feature ids -> int32 slot array. This replaces ps-lite's
+  key->server-range slicing (kvstore_dist.h:90-118); the "message" is just a
+  gather/scatter index vector.
+- value-type channels kFeaCount/kWeight/kGradient (include/difacto/store.h:
+  33-35) survive as the three jitted entry points apply_count / get_rows(pull)
+  / apply_grad(push).
+- checkpoint save/load with optional aux state (Updater::Save/Load,
+  src/sgd/sgd_updater.h:84-106) and TSV dump (sgd_updater.h:108-139).
+
+Capacity grows by doubling (shape change => one re-jit per doubling,
+log2(total/initial) times overall).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import FEAID_DTYPE, reverse_bytes
+from ..updaters.sgd_updater import (SGDState, SGDUpdaterParam, TRASH_SLOT,
+                                    grow_state, init_state, make_fns)
+
+# store value-type channel tags (include/difacto/store.h:33-35)
+K_FEACOUNT = 1
+K_WEIGHT = 2
+K_GRADIENT = 3
+
+
+class SlotStore:
+    """Single-controller store over one (possibly sharded) slot table."""
+
+    def __init__(self, param: SGDUpdaterParam, initial_capacity: int = 1 << 14):
+        self.param = param
+        self.fns = make_fns(param)
+        self._dict: Dict[int, int] = {}
+        self._next_slot = TRASH_SLOT + 1
+        self.state: SGDState = init_state(param, initial_capacity)
+
+    # ------------------------------------------------------------- keys
+    @property
+    def num_features(self) -> int:
+        return len(self._dict)
+
+    def map_keys(self, keys: np.ndarray, insert: bool = True) -> np.ndarray:
+        """Map uint64 ids -> int32 slots; unknown ids are inserted (the
+        reference's operator[] inserts on Get too, sgd_updater.cc:46) or
+        mapped to TRASH_SLOT when insert=False."""
+        d = self._dict
+        out = np.empty(len(keys), dtype=np.int32)
+        if insert:
+            nxt = self._next_slot
+            for i, k in enumerate(keys.tolist()):
+                s = d.get(k)
+                if s is None:
+                    s = nxt
+                    d[k] = s
+                    nxt += 1
+                out[i] = s
+            self._next_slot = nxt
+            self._ensure_capacity(nxt)
+        else:
+            for i, k in enumerate(keys.tolist()):
+                out[i] = d.get(k, TRASH_SLOT)
+        return out
+
+    def _ensure_capacity(self, need: int) -> None:
+        cap = self.state.capacity
+        if need <= cap:
+            return
+        while cap < need:
+            cap *= 2
+        self.state = grow_state(self.param, self.state, cap)
+
+    def pad_slots(self, slots: np.ndarray, cap: int) -> jnp.ndarray:
+        out = np.full(cap, TRASH_SLOT, dtype=np.int32)
+        out[:len(slots)] = slots
+        return jnp.asarray(out)
+
+    # ------------------------------------------------------------- KV API
+    # Reference-shaped Push/Pull for learners that want the explicit KV
+    # contract (L-BFGS/BCD); the SGD hot path fuses these into its jit step.
+    def pull(self, keys: np.ndarray) -> Tuple[np.ndarray, Optional[np.ndarray],
+                                              Optional[np.ndarray]]:
+        slots = jnp.asarray(self.map_keys(keys))
+        w, V, vmask = self.fns.get_rows(self.state, slots)
+        return (np.asarray(w),
+                None if V is None else np.asarray(V),
+                None if vmask is None else np.asarray(vmask))
+
+    def push(self, keys: np.ndarray, val_type: int,
+             gw: np.ndarray, gV: Optional[np.ndarray] = None,
+             vmask: Optional[np.ndarray] = None) -> None:
+        slots = jnp.asarray(self.map_keys(keys))
+        if val_type == K_FEACOUNT:
+            self.state = self.fns.apply_count(self.state, slots,
+                                              jnp.asarray(gw))
+        elif val_type == K_GRADIENT:
+            self.state = self.fns.apply_grad(
+                self.state, slots, jnp.asarray(gw),
+                None if gV is None else jnp.asarray(gV),
+                None if vmask is None else jnp.asarray(vmask))
+        else:
+            raise ValueError(f"unknown val_type {val_type}")
+
+    def evaluate(self) -> Tuple[float, float]:
+        penalty, nnz = self.fns.evaluate(self.state)
+        return float(penalty), float(nnz)
+
+    # ------------------------------------------------------------- ckpt
+    def _sorted_items(self) -> Tuple[np.ndarray, np.ndarray]:
+        keys = np.fromiter(self._dict.keys(), dtype=FEAID_DTYPE,
+                           count=len(self._dict))
+        slots = np.fromiter(self._dict.values(), dtype=np.int64,
+                            count=len(self._dict))
+        order = np.argsort(keys)
+        return keys[order], slots[order]
+
+    def save(self, path: str, save_aux: bool = False) -> int:
+        """Checkpoint non-empty entries, sorted by key."""
+        keys, slots = self._sorted_items()
+        st = {f: np.asarray(a) for f, a in zip(SGDState._fields, self.state)}
+        keep = (st["w"][slots] != 0) | (st["cnt"][slots] != 0)
+        if self.param.V_dim > 0:
+            keep |= st["v_live"][slots]
+        keys, slots = keys[keep], slots[keep]
+        arrays = dict(
+            keys=keys,
+            w=st["w"][slots],
+            cnt=st["cnt"][slots],
+            v_live=st["v_live"][slots],
+            V=st["V"][slots],
+            save_aux=np.array(save_aux),
+            V_dim=np.array(self.param.V_dim),
+        )
+        if save_aux:
+            arrays.update(z=st["z"][slots], sqrt_g=st["sqrt_g"][slots],
+                          Vg=st["Vg"][slots])
+        tmp = path + ".tmp.npz"  # .npz suffix stops savez appending its own
+        np.savez_compressed(tmp, **arrays)
+        os.replace(tmp, path)
+        return len(keys)
+
+    def load(self, path: str) -> int:
+        with np.load(path) as z:
+            ck_vdim = int(z["V_dim"]) if "V_dim" in z.files else 0
+            if ck_vdim != self.param.V_dim:
+                raise ValueError(
+                    f"checkpoint V_dim={ck_vdim} != configured "
+                    f"V_dim={self.param.V_dim} ({path})")
+            keys = z["keys"]
+            n = len(keys)
+            self._dict = {int(k): i + 1 for i, k in enumerate(keys)}
+            self._next_slot = n + 1
+            cap = self.state.capacity
+            while cap < n + 1:
+                cap *= 2
+            st = init_state(self.param, cap)
+            arr = {f: np.asarray(a).copy() for f, a in zip(SGDState._fields, st)}
+            sl = np.arange(1, n + 1)
+            arr["w"][sl] = z["w"]
+            arr["cnt"][sl] = z["cnt"]
+            arr["v_live"][sl] = z["v_live"]
+            if z["V"].size:
+                arr["V"][sl] = z["V"]
+            if "z" in z.files:
+                arr["z"][sl] = z["z"]
+                arr["sqrt_g"][sl] = z["sqrt_g"]
+                if z["Vg"].size:
+                    arr["Vg"][sl] = z["Vg"]
+            self.state = SGDState(**{f: jnp.asarray(a)
+                                     for f, a in arr.items()})
+        return n
+
+    def dump(self, path: str, dump_aux: bool = False,
+             need_reverse: bool = True) -> int:
+        """Human-readable TSV export (Updater::Dump, sgd_updater.h:108-139):
+        ``feaid size w [sqrt_g z] V... [Vg...]`` per line, skipping empty
+        entries. need_reverse un-reverses ids back to the original space."""
+        keys, slots = self._sorted_items()
+        st = {f: np.asarray(a) for f, a in zip(SGDState._fields, self.state)}
+        n = 0
+        with open(path, "w") as f:
+            for k, s in zip(keys, slots):
+                w = st["w"][s]
+                live = bool(st["v_live"][s]) and self.param.V_dim > 0
+                if w == 0 and not live:
+                    continue
+                key = reverse_bytes(int(k)) if need_reverse else int(k)
+                size = 1 + (self.param.V_dim if live else 0)
+                cols = [str(key), str(size), repr(float(w))]
+                if dump_aux:
+                    cols += [repr(float(st["sqrt_g"][s])),
+                             repr(float(st["z"][s]))]
+                if live:
+                    cols += [repr(float(v)) for v in st["V"][s]]
+                    if dump_aux:
+                        cols += [repr(float(v)) for v in st["Vg"][s]]
+                f.write("\t".join(cols) + "\n")
+                n += 1
+        return n

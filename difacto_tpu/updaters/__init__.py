@@ -1,0 +1,5 @@
+from .sgd_updater import (SGDState, SGDUpdaterParam, init_state, make_fns,
+                          TRASH_SLOT)
+
+__all__ = ["SGDState", "SGDUpdaterParam", "init_state", "make_fns",
+           "TRASH_SLOT"]

@@ -1,0 +1,201 @@
+"""SGD updater: FTRL for w, AdaGrad for V, over a fixed-capacity slot table.
+
+TPU-native re-design of the reference's server-side SGDUpdater
+(src/sgd/sgd_updater.{h,cc}). The per-feature hash map of SGDEntry records
+(sgd_updater.h:20-69) becomes a struct-of-arrays slot table in device memory;
+per-key scalar updates (sgd_updater.cc:105-152) become vectorised gather ->
+elementwise -> scatter over the batch's unique slots. **Row 0 is a reserved
+trash slot**: padded/invalid entries scatter there, so every kernel runs
+unconditionally with static shapes.
+
+Exact semantics preserved:
+
+- FTRL-proximal w update (UpdateW, sgd_updater.cc:105-131): g += l2*w;
+  n' = sqrt(n^2 + g^2); z -= g - (n' - n)/lr * w; w = soft-threshold(z, l1)
+  scaled by lr/(lr_beta + n').
+- AdaGrad V update (UpdateV, sgd_updater.cc:133-142) with V_l2, applied only
+  to rows whose embedding was *pulled* this batch (lens[i] > 1 semantics,
+  sgd_updater.cc:91-96).
+- Lazy V activation (InitV triggers, sgd_updater.cc:71-74,123-127): the union
+  of the reference's two trigger sites is exactly
+  ``v_live |= (w != 0) & (cnt > V_threshold)`` re-evaluated after every count
+  or gradient update. V rows are pre-filled with the uniform init
+  ``(u01 - 0.5) * V_init_scale`` (InitV, sgd_updater.cc:144-152) at state
+  creation — activation just flips the flag. (Deviation: init values come
+  from a counter-based PRNG per slot, not the reference's call-order-dependent
+  rand_r stream; distribution is identical.)
+- Pull gating (Get, sgd_updater.cc:34-58): the embedding is served only when
+  live and not suppressed by ``l1_shrk`` (w == 0).
+- Evaluate (sgd_updater.cc:15-32): penalty uses **l2 for the V term as well**
+  (a reference quirk — UpdateV regularises with V_l2 but Evaluate charges
+  l2); nnz counts V_dim for every live embedding regardless of w.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Param
+
+TRASH_SLOT = 0  # row 0 absorbs padded scatters; never a real feature
+
+
+@dataclass
+class SGDUpdaterParam(Param):
+    l1: float = field(default=1.0, metadata=dict(lo=0, hi=1e10))
+    l2: float = field(default=0.0, metadata=dict(lo=0, hi=1e10))
+    V_l2: float = field(default=0.01, metadata=dict(lo=0, hi=1e10))
+    lr: float = field(default=0.01, metadata=dict(lo=0, hi=10))
+    lr_beta: float = field(default=1.0, metadata=dict(lo=0, hi=1e10))
+    V_lr: float = field(default=0.01, metadata=dict(lo=0, hi=1e10))
+    V_lr_beta: float = field(default=1.0, metadata=dict(lo=0, hi=10))
+    V_init_scale: float = field(default=0.01, metadata=dict(lo=0, hi=10))
+    V_dim: int = field(default=0, metadata=dict(lo=0))
+    V_threshold: int = 10
+    l1_shrk: bool = True
+    seed: int = 0
+
+
+class SGDState(NamedTuple):
+    """Slot-table model state; all arrays have capacity+1 rows (row 0 trash)."""
+    w: jnp.ndarray        # f32[C]
+    z: jnp.ndarray        # f32[C] FTRL dual
+    sqrt_g: jnp.ndarray   # f32[C] FTRL accumulated grad norm
+    cnt: jnp.ndarray      # f32[C] feature occurrence counts
+    V: jnp.ndarray        # f32[C, k] embeddings (k may be 0)
+    Vg: jnp.ndarray       # f32[C, k] AdaGrad accumulators
+    v_live: jnp.ndarray   # bool[C] embedding activated
+
+    @property
+    def capacity(self) -> int:
+        return self.w.shape[0]
+
+
+def init_state(param: SGDUpdaterParam, capacity: int) -> SGDState:
+    k = param.V_dim
+    key = jax.random.PRNGKey(param.seed)
+    V = (jax.random.uniform(key, (capacity, k), dtype=jnp.float32) - 0.5) \
+        * param.V_init_scale
+    def zeros():
+        # distinct buffers — donate_argnums forbids aliased leaves
+        return jnp.zeros(capacity, dtype=jnp.float32)
+    return SGDState(
+        w=zeros(), z=zeros(), sqrt_g=zeros(), cnt=zeros(),
+        V=V, Vg=jnp.zeros((capacity, k), dtype=jnp.float32),
+        v_live=jnp.zeros(capacity, dtype=bool),
+    )
+
+
+def grow_state(param: SGDUpdaterParam, state: SGDState, new_capacity: int
+               ) -> SGDState:
+    """Double-and-copy growth; new V rows get fresh init values."""
+    old = state.capacity
+    if new_capacity <= old:
+        return state
+    ext = init_state(param, new_capacity)
+    return SGDState(*(jnp.concatenate([a, jnp.asarray(b)[old:]], axis=0)
+                      for a, b in zip(state, ext)))
+
+
+def _refresh_v_live(param: SGDUpdaterParam, state: SGDState) -> jnp.ndarray:
+    if param.V_dim == 0:
+        return state.v_live
+    return state.v_live | ((state.w != 0)
+                           & (state.cnt > float(param.V_threshold)))
+
+
+def make_fns(param: SGDUpdaterParam):
+    """Build the pure update/get functions with hyperparameters baked in
+    as compile-time constants. Returns a namespace of jit-ready callables
+    (not yet jit-wrapped; the store/learner composes and jits them)."""
+
+    l1, l2 = param.l1, param.l2
+    lr, lr_beta = param.lr, param.lr_beta
+    V_l2, V_lr, V_lr_beta = param.V_l2, param.V_lr, param.V_lr_beta
+    has_V = param.V_dim > 0
+
+    def get_rows(state: SGDState, slots: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
+                            Optional[jnp.ndarray]]:
+        """Pull [w, V, v_mask] rows for the batch's unique slots (Get)."""
+        w = state.w[slots]
+        if not has_V:
+            return w, None, None
+        vmask = state.v_live[slots]
+        if param.l1_shrk:
+            vmask = vmask & (w != 0)
+        return w, state.V[slots], vmask.astype(jnp.float32)
+
+    def apply_count(state: SGDState, slots: jnp.ndarray, counts: jnp.ndarray
+                    ) -> SGDState:
+        """kFeaCount push (Update, sgd_updater.cc:64-75). Padded entries must
+        carry count 0 and slot TRASH_SLOT."""
+        cnt = state.cnt.at[slots].add(counts)
+        state = state._replace(cnt=cnt)
+        return state._replace(v_live=_refresh_v_live(param, state))
+
+    def apply_grad(state: SGDState, slots: jnp.ndarray,
+                   gw: jnp.ndarray, gV: Optional[jnp.ndarray],
+                   pull_vmask: Optional[jnp.ndarray]) -> SGDState:
+        """kGradient push: FTRL(w) + AdaGrad(V). ``slots`` are unique
+        (padding -> TRASH_SLOT, whose gw must be 0)."""
+        w = state.w[slots]
+        sg = state.sqrt_g[slots]
+        z = state.z[slots]
+
+        g = gw + l2 * w
+        sg_new = jnp.sqrt(sg * sg + g * g)
+        z_new = z - (g - (sg_new - sg) / lr * w)
+        eta = (lr_beta + sg_new) / lr
+        w_new = jnp.where(
+            jnp.abs(z_new) <= l1, 0.0,
+            (z_new - jnp.sign(z_new) * l1) / eta)
+
+        state = state._replace(
+            w=state.w.at[slots].set(w_new),
+            sqrt_g=state.sqrt_g.at[slots].set(sg_new),
+            z=state.z.at[slots].set(z_new),
+        )
+
+        if has_V and gV is not None:
+            V = state.V[slots]
+            Vg = state.Vg[slots]
+            gv = gV + V_l2 * V
+            Vg_new = jnp.sqrt(Vg * Vg + gv * gv)
+            V_new = V - V_lr / (Vg_new + V_lr_beta) * gv
+            upd = pull_vmask[:, None] > 0
+            state = state._replace(
+                V=state.V.at[slots].set(jnp.where(upd, V_new, V)),
+                Vg=state.Vg.at[slots].set(jnp.where(upd, Vg_new, Vg)),
+            )
+
+        return state._replace(v_live=_refresh_v_live(param, state))
+
+    def evaluate(state: SGDState) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(penalty, nnz) over real rows (Evaluate, sgd_updater.cc:15-32)."""
+        w = state.w.at[TRASH_SLOT].set(0.0)
+        penalty = jnp.sum(l1 * jnp.abs(w) + 0.5 * l2 * w * w)
+        nnz = jnp.sum((w != 0).astype(jnp.float32))
+        if has_V:
+            live = state.v_live.at[TRASH_SLOT].set(False)
+            Vm = state.V * live[:, None]
+            # quirk preserved: Evaluate charges l2 (not V_l2) on V
+            penalty = penalty + jnp.sum(0.5 * l2 * Vm * Vm)
+            nnz = nnz + jnp.sum(live) * param.V_dim
+        return penalty, nnz
+
+    class _NS:
+        pass
+
+    ns = _NS()
+    ns.get_rows = get_rows
+    ns.apply_count = apply_count
+    ns.apply_grad = apply_grad
+    ns.evaluate = evaluate
+    ns.param = param
+    return ns

@@ -1,0 +1,3 @@
+from .progress import Progress, ReportProg
+
+__all__ = ["Progress", "ReportProg"]
