@@ -1,0 +1,113 @@
+"""Benchmark: FM training-step throughput (examples/sec) on one chip.
+
+Measures the full fused SGD hot path — gather [w,V] rows, FM forward
+(SpMV + 2×SpMM sum-of-squares), logit objective + AUC, backward, FTRL/AdaGrad
+scatter update — on synthetic Criteo-like batches (V_dim=64, ~39 nnz/row),
+the north-star config of BASELINE.md.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` compares against an *estimated* 32-worker ps-lite CPU
+aggregate throughput on the same workload (the reference publishes no numbers
+— BASELINE.json.published is empty; see BASELINE.md). Estimate: 32 workers ×
+~15k examples/s/worker for FM V_dim=64 ≈ 5e5 examples/s. The driver-set target
+is vs_baseline >= 20 on a full v5e-8 (i.e. >= 2.5 per chip × 8).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+# estimated 32-worker ps-lite CPU examples/sec on Criteo FM V_dim=64 (see
+# module docstring; the reference repo publishes no quantitative baseline)
+REF_PSLITE_32W_EPS = 5.0e5
+
+
+def build_step(V_dim: int, capacity: int):
+    import jax
+
+    from difacto_tpu.losses import create
+    from difacto_tpu.step import make_step_fns
+    from difacto_tpu.updaters.sgd_updater import (SGDUpdaterParam, init_state,
+                                                  make_fns)
+
+    param = SGDUpdaterParam(V_dim=V_dim, V_threshold=0, lr=0.1, l1=1e-4,
+                            l2=1e-4)
+    fns = make_fns(param)
+    loss = create("fm", V_dim)
+    state = init_state(param, capacity)
+    if V_dim:
+        import jax.numpy as jnp
+        state = state._replace(v_live=jnp.ones(capacity, dtype=bool))
+
+    _, train_step, _ = make_step_fns(fns, loss)
+    return jax.jit(train_step, donate_argnums=0), state
+
+
+def make_batches(n: int, B: int, nnz_per_row: int, U: int, capacity: int,
+                 seed: int = 0):
+    """Pre-generate host-side localized batches (COO + slot vectors)."""
+    from difacto_tpu.data.rowblock import RowBlock
+    from difacto_tpu.ops.batch import pad_batch
+
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        offset = np.arange(B + 1, dtype=np.int64) * nnz_per_row
+        index = rng.randint(0, U, B * nnz_per_row).astype(np.uint32)
+        blk = RowBlock(
+            offset=offset,
+            label=rng.choice([0.0, 1.0], B).astype(np.float32),
+            index=index,
+            value=None,  # binary features, like criteo
+        )
+        batch = pad_batch(blk, num_uniq=U, batch_cap=B,
+                          nnz_cap=B * nnz_per_row)
+        slots = (rng.permutation(capacity - 1)[:U] + 1).astype(np.int32)
+        out.append((batch, np.sort(slots)))
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=8192)
+    ap.add_argument("--vdim", type=int, default=64)
+    ap.add_argument("--nnz-per-row", type=int, default=39)  # criteo density
+    ap.add_argument("--uniq", type=int, default=1 << 17)
+    ap.add_argument("--capacity", type=int, default=1 << 21)
+    ap.add_argument("--steps", type=int, default=40)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    step, state = build_step(args.vdim, args.capacity)
+    batches = [(jax.device_put(b), jnp.asarray(s))
+               for b, s in make_batches(8, args.batch_size, args.nnz_per_row,
+                                        args.uniq, args.capacity)]
+
+    # warmup / compile
+    state, objv, auc = step(state, *batches[0])
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, objv, auc = step(state, *batches[i % len(batches)])
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    eps = args.steps * args.batch_size / dt
+    print(json.dumps({
+        "metric": "fm_v64_train_examples_per_sec",
+        "value": round(eps, 1),
+        "unit": "examples/sec",
+        "vs_baseline": round(eps / REF_PSLITE_32W_EPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

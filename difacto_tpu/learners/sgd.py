@@ -32,8 +32,7 @@ import numpy as np
 
 from ..config import KWArgs, Param
 from ..data import BatchReader, Reader, compact
-from ..losses import FMParams, create as create_loss
-from ..losses.metrics import auc_times_n_jnp
+from ..losses import create as create_loss
 from ..ops.batch import bucket, pad_batch
 from ..store.local import SlotStore
 from ..updaters.sgd_updater import SGDUpdaterParam
@@ -68,6 +67,11 @@ class SGDLearnerParam(Param):
     stop_val_auc: float = 1e-5
     has_aux: bool = False
     task: int = 0  # 0 = train, 2 = predict (main.cc task names train/predict)
+    # SPMD mesh (parallel/mesh.py): feature shards ("servers") × data
+    # parallelism ("workers"); 1×1 = single device. The reference analog is
+    # launch.py's -s/-n server/worker counts.
+    mesh_fs: int = 1
+    mesh_dp: int = 1
 
 
 @register("sgd")
@@ -89,33 +93,20 @@ class SGDLearner(Learner):
         self.V_dim = self.loss.V_dim
         if uparam.V_dim != self.V_dim:
             uparam = dataclasses.replace(uparam, V_dim=self.V_dim)
-        self.store = SlotStore(uparam)
+        self.mesh = None
+        if self.param.mesh_fs * self.param.mesh_dp > 1:
+            from ..parallel import make_mesh
+            self.mesh = make_mesh(dp=self.param.mesh_dp,
+                                  fs=self.param.mesh_fs)
+        self.store = SlotStore(uparam, mesh=self.mesh)
         self.do_embedding = self.V_dim > 0
         self._build_steps()
         return remain
 
     def _build_steps(self) -> None:
+        from ..step import make_step_fns
         fns = self.store.fns
-        loss = self.loss
-
-        def forward(state, batch, slots):
-            w, V, vmask = fns.get_rows(state, slots)
-            params = FMParams(w=w, V=V, v_mask=vmask)
-            pred = loss.predict(params, batch)
-            objv = loss.evaluate(pred, batch)
-            auc = auc_times_n_jnp(batch.labels, pred, batch.row_mask)
-            return params, pred, objv, auc
-
-        def train_step(state, batch, slots):
-            params, pred, objv, auc = forward(state, batch, slots)
-            gw, gV = loss.calc_grad(params, batch, pred)
-            state = fns.apply_grad(state, slots, gw, gV, params.v_mask)
-            return state, objv, auc
-
-        def eval_step(state, batch, slots):
-            _, pred, objv, auc = forward(state, batch, slots)
-            return pred, objv, auc
-
+        _, train_step, eval_step = make_step_fns(fns, self.loss)
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
         self._apply_count = jax.jit(fns.apply_count, donate_argnums=0)
@@ -160,12 +151,10 @@ class SGDLearner(Learner):
             for cb in self.epoch_end_callbacks:
                 cb(k, train_prog, val_prog)
 
-            # stop criteria (sgd_learner.cc:92-110): note the reference
-            # divides by pre_loss with no zero guard — first epoch gives
-            # inf/nan which never triggers, same here via numpy semantics
-            with np.errstate(divide="ignore", invalid="ignore"):
-                eps = abs(train_prog.loss - pre_loss) / pre_loss \
-                    if pre_loss else float("inf")
+            # stop criteria (sgd_learner.cc:92-110): the reference divides by
+            # pre_loss with no zero guard — first epoch never triggers
+            eps = abs(train_prog.loss - pre_loss) / pre_loss \
+                if pre_loss else float("inf")
             if eps < p.stop_rel_objv:
                 log.info("change of loss [%g] < stop_rel_objv [%g]",
                          eps, p.stop_rel_objv)
@@ -243,6 +232,9 @@ class SGDLearner(Learner):
             dev = pad_batch(cblk, num_uniq=len(uniq),
                             batch_cap=bucket(blk.size),
                             nnz_cap=bucket(blk.nnz))
+            if self.mesh is not None:
+                from ..parallel import batch_sharding, shard_pytree
+                dev = shard_pytree(dev, batch_sharding(self.mesh))
             if push_cnt:
                 c = np.zeros(u_cap, dtype=np.float32)
                 c[:len(cnts)] = cnts

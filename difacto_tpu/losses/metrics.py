@@ -63,7 +63,7 @@ def accuracy_times_n(label: np.ndarray, pred: np.ndarray,
 def logloss(label: np.ndarray, pred: np.ndarray) -> float:
     y = (label > 0).astype(np.float64)
     p = 1.0 / (1.0 + np.exp(-pred.astype(np.float64)))
-    p = np.clip(p, 1e-10, 1.0)
+    p = np.clip(p, 1e-10, 1.0 - 1e-10)
     return float(-np.sum(y * np.log(p) + (1 - y) * np.log1p(-p)))
 
 

@@ -1,0 +1,7 @@
+"""Parallelism: device mesh, shardings, and the ICI parameter-server layout."""
+
+from .mesh import (DP_AXIS, FS_AXIS, batch_sharding, make_mesh, replicated,
+                   shard_pytree, sharding_tree, state_sharding)
+
+__all__ = ["DP_AXIS", "FS_AXIS", "make_mesh", "state_sharding",
+           "batch_sharding", "replicated", "shard_pytree", "sharding_tree"]

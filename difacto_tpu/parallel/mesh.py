@@ -1,0 +1,92 @@
+"""Device mesh + sharding layout: the ICI "parameter server".
+
+TPU-native replacement for the reference's distributed topology
+(SURVEY §2.9): the worker/server split becomes SPMD over a 2-D
+``jax.sharding.Mesh`` with axes
+
+- ``fs`` (feature shards) — the slot table [w, z, sqrt_g, cnt, V, Vg, v_live]
+  is sharded along its capacity axis. This is the TPU analog of ps-lite's
+  key-range sharding across servers (src/store/kvstore_dist.h:90-118): the
+  byte-reversed feature-id space maps to slots, contiguous slot ranges live on
+  different devices, and the per-batch gather/scatter of unique rows is the
+  Push/Pull — XLA inserts the all-gather / reduce-scatter collectives that
+  ps-lite implemented as ZMQ messages.
+- ``dp`` (data parallel) — the batch COO arrays are sharded along their
+  nnz/row axes, the analog of DiFacto's worker data parallelism
+  (file parts dispatched by WorkloadPool, src/tracker/dist_tracker.h:136-156).
+  Unlike the reference's *asynchronous* per-worker updates, the TPU step is
+  synchronous: all dp shards contribute to one gradient segment-sum
+  (SURVEY §7 "hard parts (b)").
+
+All shapes are padded to power-of-two buckets (ops/batch.py), so any mesh with
+power-of-two axis sizes divides them evenly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP_AXIS = "dp"
+FS_AXIS = "fs"
+
+
+def make_mesh(dp: int = 1, fs: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a (dp, fs) mesh over the first dp*fs available devices.
+
+    Axis sizes must be powers of two: every sharded dimension (slot-table
+    capacity, batch/nnz buckets) is padded to a power of two, so only
+    power-of-two axes divide them evenly.
+    """
+    for name, v in ((DP_AXIS, dp), (FS_AXIS, fs)):
+        if v < 1 or (v & (v - 1)) != 0:
+            raise ValueError(f"mesh axis {name}={v} must be a power of two")
+    n = dp * fs
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(dp, fs)
+    return Mesh(arr, (DP_AXIS, FS_AXIS))
+
+
+def state_sharding(mesh: Mesh):
+    """NamedSharding pytree spec for SGDState: capacity axis over fs.
+
+    Applied via tree_map by leaf rank: 1-D [C] -> P('fs'),
+    2-D [C, k] -> P('fs', None).
+    """
+    def spec(x):
+        nd = np.ndim(x) if not hasattr(x, "ndim") else x.ndim
+        return NamedSharding(mesh, P(FS_AXIS, *([None] * (nd - 1))))
+    return spec
+
+
+def batch_sharding(mesh: Mesh):
+    """NamedSharding for DeviceBatch leaves: leading axis over dp,
+    scalars replicated."""
+    def spec(x):
+        nd = np.ndim(x) if not hasattr(x, "ndim") else x.ndim
+        if nd == 0:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, P(DP_AXIS, *([None] * (nd - 1))))
+    return spec
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_pytree(tree, spec_fn):
+    """device_put every leaf with its NamedSharding from spec_fn(leaf)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, spec_fn(x)), tree)
+
+
+def sharding_tree(tree, spec_fn):
+    """A pytree of NamedShardings matching ``tree`` (for jit in/out specs)."""
+    return jax.tree_util.tree_map(lambda x: spec_fn(x), tree)

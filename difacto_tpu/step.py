@@ -1,0 +1,44 @@
+"""The fused SGD train/eval step — the single source of truth for the hot path.
+
+One device program replaces the reference's 3-thread worker pipeline
+(src/sgd/sgd_learner.h:85-102): gather [w, V] rows from the slot table
+("Pull"), FM/logit forward, objective + AUC, backward, FTRL/AdaGrad scatter
+update ("Push"). The learner (learners/sgd.py), the driver entry
+(__graft_entry__.py) and the benchmark (bench.py) all build their steps here
+so they can never drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from .losses import FMParams, LossSpec
+from .losses.metrics import auc_times_n_jnp
+
+
+def make_step_fns(fns, loss: LossSpec) -> Tuple:
+    """(forward, train_step, eval_step) over (state, batch, slots).
+
+    ``fns`` is the updater namespace from updaters.sgd_updater.make_fns;
+    all three returned callables are pure and jit-ready.
+    """
+
+    def forward(state, batch, slots):
+        w, V, vmask = fns.get_rows(state, slots)
+        params = FMParams(w=w, V=V, v_mask=vmask)
+        pred = loss.predict(params, batch)
+        objv = loss.evaluate(pred, batch)
+        auc = auc_times_n_jnp(batch.labels, pred, batch.row_mask)
+        return params, pred, objv, auc
+
+    def train_step(state, batch, slots):
+        params, pred, objv, auc = forward(state, batch, slots)
+        gw, gV = loss.calc_grad(params, batch, pred)
+        state = fns.apply_grad(state, slots, gw, gV, params.v_mask)
+        return state, objv, auc
+
+    def eval_step(state, batch, slots):
+        _, pred, objv, auc = forward(state, batch, slots)
+        return pred, objv, auc
+
+    return forward, train_step, eval_step

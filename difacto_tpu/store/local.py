@@ -22,7 +22,7 @@ log2(total/initial) times overall).
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -38,41 +38,72 @@ K_GRADIENT = 3
 
 
 class SlotStore:
-    """Single-controller store over one (possibly sharded) slot table."""
+    """Single-controller store over one (possibly sharded) slot table.
 
-    def __init__(self, param: SGDUpdaterParam, initial_capacity: int = 1 << 14):
+    With ``mesh`` set, every state array is placed feature-axis-sharded over
+    the mesh's ``fs`` axis (parallel/mesh.py) — the TPU analog of ps-lite's
+    key-range server sharding. The learner's jit steps then carry matching
+    in/out shardings so the table never leaves its layout.
+    """
+
+    def __init__(self, param: SGDUpdaterParam, initial_capacity: int = 1 << 14,
+                 mesh=None):
         self.param = param
         self.fns = make_fns(param)
-        self._dict: Dict[int, int] = {}
+        self.mesh = mesh
+        # feature dictionary as parallel sorted arrays (id -> slot); bulk
+        # lookup/insert is vectorised via searchsorted + merge — the host-side
+        # analog of ps-lite's sorted-key requirement (kvstore_dist.h:95)
+        self._keys = np.empty(0, dtype=FEAID_DTYPE)
+        self._slots = np.empty(0, dtype=np.int64)
         self._next_slot = TRASH_SLOT + 1
-        self.state: SGDState = init_state(param, initial_capacity)
+        self.state: SGDState = self._place(init_state(param, initial_capacity))
+
+    def _place(self, state: SGDState) -> SGDState:
+        if self.mesh is None:
+            return state
+        from ..parallel import shard_pytree, state_sharding
+        return shard_pytree(state, state_sharding(self.mesh))
 
     # ------------------------------------------------------------- keys
     @property
     def num_features(self) -> int:
-        return len(self._dict)
+        return len(self._keys)
 
     def map_keys(self, keys: np.ndarray, insert: bool = True) -> np.ndarray:
-        """Map uint64 ids -> int32 slots; unknown ids are inserted (the
-        reference's operator[] inserts on Get too, sgd_updater.cc:46) or
-        mapped to TRASH_SLOT when insert=False."""
-        d = self._dict
-        out = np.empty(len(keys), dtype=np.int32)
-        if insert:
-            nxt = self._next_slot
-            for i, k in enumerate(keys.tolist()):
-                s = d.get(k)
-                if s is None:
-                    s = nxt
-                    d[k] = s
-                    nxt += 1
-                out[i] = s
-            self._next_slot = nxt
-            self._ensure_capacity(nxt)
+        """Map *unique* uint64 ids -> int32 slots; unknown ids are inserted
+        (the reference's operator[] inserts on Get too, sgd_updater.cc:46) or
+        mapped to TRASH_SLOT when insert=False. New slots are assigned in the
+        input's appearance order."""
+        keys = np.asarray(keys, dtype=FEAID_DTYPE)
+        n = len(self._keys)
+        out = np.full(len(keys), TRASH_SLOT, dtype=np.int32)
+        if n:
+            idx = np.searchsorted(self._keys, keys)
+            safe = np.minimum(idx, n - 1)
+            hit = (idx < n) & (self._keys[safe] == keys)
+            out[hit] = self._slots[idx[hit]]
         else:
-            for i, k in enumerate(keys.tolist()):
-                out[i] = d.get(k, TRASH_SLOT)
+            hit = np.zeros(len(keys), dtype=bool)
+        if insert:
+            miss = ~hit
+            n_new = int(miss.sum())
+            if n_new:
+                new_keys = keys[miss]
+                new_slots = self._next_slot + np.arange(n_new, dtype=np.int64)
+                out[miss] = new_slots.astype(np.int32)
+                self._next_slot += n_new
+                order = np.argsort(new_keys, kind="stable")
+                nk, ns = new_keys[order], new_slots[order]
+                pos = np.searchsorted(self._keys, nk)
+                self._keys = np.insert(self._keys, pos, nk)
+                self._slots = np.insert(self._slots, pos, ns)
+                self._ensure_capacity(self._next_slot)
         return out
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Slots for known ids, TRASH_SLOT for unknown (no insertion)."""
+        return self.map_keys(keys, insert=False)
 
     def _ensure_capacity(self, need: int) -> None:
         cap = self.state.capacity
@@ -80,11 +111,15 @@ class SlotStore:
             return
         while cap < need:
             cap *= 2
-        self.state = grow_state(self.param, self.state, cap)
+        self.state = self._place(grow_state(self.param, self.state, cap))
 
     def pad_slots(self, slots: np.ndarray, cap: int) -> jnp.ndarray:
         out = np.full(cap, TRASH_SLOT, dtype=np.int32)
         out[:len(slots)] = slots
+        if self.mesh is not None:
+            import jax
+            from ..parallel import replicated
+            return jax.device_put(out, replicated(self.mesh))
         return jnp.asarray(out)
 
     # ------------------------------------------------------------- KV API
@@ -119,12 +154,7 @@ class SlotStore:
 
     # ------------------------------------------------------------- ckpt
     def _sorted_items(self) -> Tuple[np.ndarray, np.ndarray]:
-        keys = np.fromiter(self._dict.keys(), dtype=FEAID_DTYPE,
-                           count=len(self._dict))
-        slots = np.fromiter(self._dict.values(), dtype=np.int64,
-                            count=len(self._dict))
-        order = np.argsort(keys)
-        return keys[order], slots[order]
+        return self._keys, self._slots
 
     def save(self, path: str, save_aux: bool = False) -> int:
         """Checkpoint non-empty entries, sorted by key."""
@@ -160,7 +190,8 @@ class SlotStore:
                     f"V_dim={self.param.V_dim} ({path})")
             keys = z["keys"]
             n = len(keys)
-            self._dict = {int(k): i + 1 for i, k in enumerate(keys)}
+            self._keys = keys.astype(FEAID_DTYPE)  # saved sorted
+            self._slots = np.arange(1, n + 1, dtype=np.int64)
             self._next_slot = n + 1
             cap = self.state.capacity
             while cap < n + 1:
@@ -178,8 +209,8 @@ class SlotStore:
                 arr["sqrt_g"][sl] = z["sqrt_g"]
                 if z["Vg"].size:
                     arr["Vg"][sl] = z["Vg"]
-            self.state = SGDState(**{f: jnp.asarray(a)
-                                     for f, a in arr.items()})
+            self.state = self._place(SGDState(
+                **{f: jnp.asarray(a) for f, a in arr.items()}))
         return n
 
     def dump(self, path: str, dump_aux: bool = False,

@@ -66,16 +66,17 @@ def test_sgd_save_load_dump(rcv1_path, tmp_path):
                            model_out=model, has_aux="true")
     learner.run()
     w_before = np.asarray(learner.store.state.w).copy()
-    dict_before = dict(learner.store._dict)
+    keys_before = learner.store._keys.copy()
+    slots_before = learner.store._slots.copy()
 
     # resume into a fresh learner: trajectory continues from saved state
     l2 = make_learner(rcv1_path, max_num_epochs="5", model_in=model)
     n = l2.store.load(l2._model_name(model, -1))
     assert n > 0
-    for k, s in l2.store._dict.items():
-        old = w_before[dict_before[k]]
-        new = float(np.asarray(l2.store.state.w)[s])
-        assert abs(old - new) < 1e-7
+    new_slots = l2.store.lookup(keys_before[w_before[slots_before] != 0])
+    old_w = w_before[slots_before][w_before[slots_before] != 0]
+    new_w = np.asarray(l2.store.state.w)[new_slots]
+    np.testing.assert_allclose(new_w, old_w, atol=1e-7)
 
     # dump TSV
     out = str(tmp_path / "dump.tsv")
