@@ -1,0 +1,105 @@
+"""CLI entry: ``python -m difacto_tpu config_file key1=val1 key2=val2 ...``
+
+Equivalent of the reference binary's main (src/main.cc:54-90): parse the
+config file + CLI overrides into KWArgs, dispatch on ``task``:
+
+- ``train`` (default) — build the learner named by ``learner`` (default sgd),
+  init with the remaining kwargs, run.
+- ``pred`` — prediction with a saved model (routes to the learner's predict
+  task, main.cc:70-77 sets task=pred and requires model_in).
+- ``dump`` — binary model -> readable TSV (src/reader/dump.h).
+- ``convert`` — data format conversion (src/reader/converter.h).
+
+Unknown leftover keys warn, as in main.cc:40-46.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from dataclasses import dataclass, field
+
+from .config import KWArgs, Param, parse_cli_args, warn_unknown
+from .learners import Learner
+
+log = logging.getLogger("difacto_tpu")
+
+
+@dataclass
+class DifactoParam(Param):
+    task: str = field(default="train", metadata=dict(
+        enum=["train", "dump", "pred", "convert"]))
+    learner: str = "sgd"
+
+
+@dataclass
+class DumpParam(Param):
+    """src/reader/dump.h:12-31."""
+    updater: str = "sgd"
+    model_in: str = ""
+    name_dump: str = "dump.txt"
+    need_reverse: bool = False
+    dump_aux: bool = False
+
+
+def run_dump(kwargs: KWArgs) -> KWArgs:
+    from .store.local import SlotStore
+    from .updaters.sgd_updater import SGDUpdaterParam
+
+    param, remain = DumpParam.init_allow_unknown(kwargs)
+    if not param.model_in:
+        raise ValueError("please set model_in")
+    if param.updater != "sgd":
+        raise ValueError(f"unknown updater: {param.updater}")
+    # V_dim is recorded in the checkpoint; probe it so the store allocates
+    # the right row width before load
+    import numpy as np
+    with np.load(param.model_in) as z:
+        v_dim = int(z["V_dim"]) if "V_dim" in z.files else 0
+    uparam, remain = SGDUpdaterParam.init_allow_unknown(remain)
+    import dataclasses
+    store = SlotStore(dataclasses.replace(uparam, V_dim=v_dim))
+    store.load(param.model_in)
+    n = store.dump(param.name_dump, param.dump_aux, param.need_reverse)
+    log.info("dumped %d features to %s", n, param.name_dump)
+    return remain
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[%(asctime)s] %(levelname)s %(message)s")
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m difacto_tpu config_file key1=val1 ...",
+              file=sys.stderr)
+        return 1
+
+    kwargs = parse_cli_args(argv)
+    param, remain = DifactoParam.init_allow_unknown(kwargs)
+
+    if param.task in ("train", "pred"):
+        if param.task == "pred" and param.learner != "sgd":
+            # only the sgd learner implements the prediction task (like the
+            # reference, where pred routes through SGDLearner's job types)
+            raise ValueError(
+                f"task=pred is only supported by learner=sgd, "
+                f"not {param.learner!r}")
+        learner = Learner.create(param.learner)
+        if param.task == "pred":
+            remain.append(("task", "2"))
+        remain = learner.init(remain)
+        warn_unknown(remain)
+        learner.run()
+    elif param.task == "dump":
+        warn_unknown(run_dump(remain))
+    elif param.task == "convert":
+        from .data.converter import Converter
+        conv = Converter()
+        warn_unknown(conv.init(remain))
+        conv.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,6 @@
 from .base import Learner, register
+from .lbfgs import LBFGSLearner, LBFGSLearnerParam, LBFGSProgress
 from .sgd import SGDLearner, SGDLearnerParam
 
-__all__ = ["Learner", "register", "SGDLearner", "SGDLearnerParam"]
+__all__ = ["Learner", "register", "SGDLearner", "SGDLearnerParam",
+           "LBFGSLearner", "LBFGSLearnerParam", "LBFGSProgress"]

@@ -1,0 +1,474 @@
+"""Distributed vector-free L-BFGS learner.
+
+TPU-native re-design of the reference's src/lbfgs/ (lbfgs_learner.{h,cc},
+lbfgs_updater.h). The reference splits state between workers (data tiles,
+loss grad) and servers (weights, s/y history, two-loop); here everything is
+dense device arrays under one controller:
+
+- the model is ONE flat vector ``weights[N]`` in the reference's exact
+  variable-length layout ``[w_i, V_i...]`` per kept feature
+  (lbfgs_updater.h:45-56) plus a trailing trash/pad region (zeros), so the
+  two-loop inner products are plain dots over the same coordinates;
+- the training data is cached as device tiles (COO chunks + per-tile
+  position arrays ``w_pos``/``V_pos`` into the flat vector — the analog of
+  TileStore colmaps + GetPos, lbfgs_learner.cc:293-313);
+- f/∇f = a jit pass over tiles accumulating a dense gradient via
+  scatter-add (CalcGrad's two-level thread pool, lbfgs_learner.cc:237-291);
+- the Gram matrix B of [s, y, g] is one einsum; the two-loop coefficients
+  are solved in float64 on host (learners/twoloop.py) — the 6m+1 inner
+  products the reference allreduced across servers become XLA reductions.
+
+The scheduler state machine (RunScheduler, lbfgs_learner.cc:14-108) is kept
+step for step: PrepareData -> InitServer -> InitWorker -> per epoch
+{PushGradient, PrepareCalcDirection, CalcDirection, Wolfe line search with
+backtracking rho, Evaluate}, with identical stop criteria and the same
+epoch-0 alpha heuristic ntrain/nnz.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import FEAID_DTYPE
+from ..config import KWArgs, Param
+from ..data import Reader, compact
+from ..losses import FMParams, fm_grad, fm_predict, logit_objv
+from ..losses.metrics import auc_times_n_jnp
+from ..ops.batch import DeviceBatch, bucket, pad_batch
+from ..ops.kv import find_position, kv_union
+from .base import Learner, register
+
+log = logging.getLogger("difacto_tpu")
+
+
+@dataclass
+class LBFGSLearnerParam(Param):
+    """src/lbfgs/lbfgs_param.h:10-77."""
+    data_in: str = ""
+    data_val: str = ""
+    data_format: str = "libsvm"
+    data_cache: str = ""
+    model_out: str = ""
+    model_in: str = ""
+    loss: str = "fm"
+    max_num_epochs: int = 100
+    min_num_epochs: int = 10
+    data_chunk_size: float = 256  # MB
+    stop_rel_objv: float = 1e-5
+    stop_val_auc: float = 1e-5
+    load_epoch: int = 0
+    init_alpha: float = 0.0  # 0 = ntrain/nnz heuristic (lbfgs_learner.cc:49)
+    alpha: float = 1.0
+    c1: float = 1e-4
+    c2: float = 0.9
+    rho: float = 0.5
+    gamma: float = 1.0
+    max_num_linesearchs: int = 5
+    num_threads: int = 0  # accepted for config parity; XLA owns threading
+
+
+@dataclass
+class LBFGSUpdaterParam(Param):
+    """src/lbfgs/lbfgs_param.h:79-104. V_dim is required (no dmlc default)."""
+    V_dim: int = -1
+    V_threshold: int = 0
+    V_init_scale: float = 0.01
+    tail_feature_filter: int = 4
+    l2: float = 0.1
+    V_l2: float = 0.01
+    m: int = 10
+    seed: int = 0
+
+
+class LBFGSProgress(NamedTuple):
+    """lbfgs::Progress (src/lbfgs/lbfgs_utils.h:45-63)."""
+    objv: float = 0.0
+    auc: float = 0.0
+    val_auc: float = 0.0
+    nnz_w: float = 0.0
+
+
+class Tile(NamedTuple):
+    """A cached device chunk: COO batch + positions into the flat vector."""
+    batch: DeviceBatch
+    w_pos: jnp.ndarray   # i32[U_cap] position of w (trash slot if filtered)
+    v_pos: jnp.ndarray   # i32[U_cap] position of V start (safe if masked)
+    v_mask: jnp.ndarray  # f32[U_cap] 1 where the feature has an embedding
+
+
+@register("lbfgs")
+class LBFGSLearner(Learner):
+    def __init__(self) -> None:
+        super().__init__()
+        self.param: Optional[LBFGSLearnerParam] = None
+        self.weight_initializer: Optional[Callable] = None
+        self.epoch_end_callbacks: List[Callable[[int, LBFGSProgress], None]] \
+            = []
+
+    # ----------------------------------------------------------- init
+    def init(self, kwargs: KWArgs) -> KWArgs:
+        self.param, remain = LBFGSLearnerParam.init_allow_unknown(kwargs)
+        self.uparam, remain = LBFGSUpdaterParam.init_allow_unknown(remain)
+        if self.uparam.V_dim < 0:
+            raise ValueError("V_dim is required for the lbfgs learner")
+        if self.param.loss == "logit":
+            self.uparam = dataclasses.replace(self.uparam, V_dim=0)
+        self.k = self.uparam.V_dim
+        self._build_steps()
+        return remain
+
+    def set_weight_initializer(self, fn: Callable) -> None:
+        """fn(lens: int32[n_feat], weights: f32[N]) -> f32[N] — the
+        deterministic-init hook (SetWeightInitializer, lbfgs_updater.h:27-32),
+        used by the golden tests in place of the C rand_r stream."""
+        self.weight_initializer = fn
+
+    # ----------------------------------------------------------- data prep
+    def _prepare_data(self) -> None:
+        """PrepareData (lbfgs_learner.cc:146-194): read once, localize, keep
+        per-chunk compact blocks + accumulate the global (id, count) dict."""
+        p = self.param
+        chunk = int(p.data_chunk_size * (1 << 20))
+        ids = np.empty(0, dtype=FEAID_DTYPE)
+        cnts = np.empty(0, dtype=np.float32)
+        self._raw_train = []
+        self._raw_val = []
+        self.ntrain = self.nval = 0
+        self.train_nnz = 0
+        for blk in Reader(p.data_in, p.data_format, chunk_bytes=chunk):
+            cblk, uniq, cnt = compact(blk, need_counts=True)
+            self._raw_train.append((cblk, uniq))
+            ids, cnts = kv_union(ids, cnts, uniq, cnt.astype(np.float32))
+            self.ntrain += blk.size
+            self.train_nnz += blk.nnz
+        if p.data_val:
+            for blk in Reader(p.data_val, p.data_format, chunk_bytes=chunk):
+                cblk, uniq, _ = compact(blk)
+                self._raw_val.append((cblk, uniq))
+                self.nval += blk.size
+        self.feaids, self.feacnts = ids, cnts
+        log.info("found %d training examples, %d features",
+                 self.ntrain, len(ids))
+
+    def _init_model(self) -> float:
+        """InitServer + InitWorker (lbfgs_updater.h:35-77,
+        lbfgs_learner.cc:196-219): tail filter, [w, V...] layout, V init.
+        Returns r(w0); also builds tiles and the regularizer vector."""
+        up = self.uparam
+        if up.tail_feature_filter > 0:
+            keep = self.feacnts > up.tail_feature_filter
+            self.feaids = self.feaids[keep]
+            self.feacnts = self.feacnts[keep]
+        nf = len(self.feaids)
+        if up.V_dim > 0:
+            lens = 1 + np.where(self.feacnts > up.V_threshold, up.V_dim, 0)
+        else:
+            lens = np.ones(nf, dtype=np.int64)
+        self.lens = lens.astype(np.int32)
+        offsets = np.zeros(nf + 1, dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        self.offsets = offsets
+        self.N = int(offsets[-1])
+        # trailing trash/pad region; last V_dim+1 slots reserved so trash
+        # V rows stay in bounds
+        self.N_pad = bucket(self.N + up.V_dim + 1)
+        self.trash_w = self.N_pad - 1
+        self.trash_v = self.N_pad - 1 - up.V_dim
+
+        w = np.zeros(self.N_pad, dtype=np.float32)
+        if self.weight_initializer is not None:
+            w[:self.N] = self.weight_initializer(
+                self.lens, w[:self.N].copy())
+        elif up.V_dim > 0:
+            # uniform V init (InitWeight, lbfgs_updater.h:60-70); counter
+            # PRNG instead of the reference's call-order rand_r stream
+            rng = np.random.RandomState(up.seed)
+            vals = (rng.rand(self.N) - 0.5) * (2 * up.V_init_scale)
+            is_w = np.zeros(self.N, dtype=bool)
+            is_w[offsets[:-1]] = True
+            w[:self.N] = np.where(is_w, 0.0, vals)
+
+        # regularizer coefficient per coordinate: l2 on w, V_l2 on V
+        c = np.zeros(self.N_pad, dtype=np.float32)
+        c[:self.N] = up.V_l2
+        c[offsets[:-1]] = up.l2
+        self.reg_c = jnp.asarray(c)
+        self.weights = jnp.asarray(w)
+
+        self.train_tiles = [self._build_tile(cb, u)
+                            for cb, u in self._raw_train]
+        self.val_tiles = [self._build_tile(cb, u) for cb, u in self._raw_val]
+        del self._raw_train, self._raw_val
+
+    def _warm_start(self, path: str) -> int:
+        """Copy checkpoint weights into the current layout (model_in warm
+        start, lbfgs_param.h model_in). Features present in both with the
+        same row length take the saved values; the rest keep their init."""
+        with np.load(self._ckpt_path(path)) as z:
+            if int(z["V_dim"]) != self.k:
+                raise ValueError("checkpoint V_dim mismatch")
+            ck_ids, ck_lens, ck_w = z["feaids"], z["lens"], z["weights"]
+        ck_off = np.zeros(len(ck_ids) + 1, dtype=np.int64)
+        np.cumsum(ck_lens, out=ck_off[1:])
+        pos = find_position(ck_ids.astype(FEAID_DTYPE), self.feaids)
+        ok = (pos >= 0) & (ck_lens[np.maximum(pos, 0)] == self.lens)
+        src_rows = pos[ok].astype(np.int64)
+        lens = self.lens[ok].astype(np.int64)
+        total = int(lens.sum())
+        rel = np.arange(total, dtype=np.int64) - np.repeat(
+            np.concatenate(([0], np.cumsum(lens[:-1]))), lens)
+        src_idx = np.repeat(ck_off[src_rows], lens) + rel
+        dst_idx = np.repeat(self.offsets[:-1][ok], lens) + rel
+        w = np.asarray(self.weights).copy()
+        w[dst_idx] = ck_w[src_idx]
+        self.weights = jnp.asarray(w)
+        return int(ok.sum())
+
+    def _build_tile(self, cblk, uniq: np.ndarray) -> Tile:
+        """BuildColmap + GetPos (tile_builder.h:115-183,
+        lbfgs_learner.cc:293-313): map tile features to flat positions."""
+        colmap = find_position(self.feaids, uniq)
+        hit = colmap >= 0
+        w_pos = np.full(len(uniq), self.trash_w, dtype=np.int64)
+        w_pos[hit] = self.offsets[colmap[hit]]
+        has_v = hit & (self.lens[np.maximum(colmap, 0)] > 1)
+        v_pos = np.full(len(uniq), self.trash_v, dtype=np.int64)
+        v_pos[has_v] = w_pos[has_v] + 1
+        u_cap = bucket(len(uniq))
+        batch = pad_batch(cblk, num_uniq=len(uniq),
+                          batch_cap=bucket(cblk.size),
+                          nnz_cap=bucket(cblk.nnz))
+
+        def pad(a, fill):
+            out = np.full(u_cap, fill, dtype=a.dtype)
+            out[:len(a)] = a
+            return out
+
+        return Tile(
+            batch=batch,
+            w_pos=jnp.asarray(pad(w_pos.astype(np.int32),
+                                  np.int32(self.trash_w))),
+            v_pos=jnp.asarray(pad(v_pos.astype(np.int32),
+                                  np.int32(self.trash_v))),
+            v_mask=jnp.asarray(pad(has_v.astype(np.float32), np.float32(0))),
+        )
+
+    # ----------------------------------------------------------- jit steps
+    def _build_steps(self) -> None:
+        k = self.k
+        gamma = self.param.gamma
+
+        def gather_params(weights, tile: Tile) -> FMParams:
+            w = weights[tile.w_pos]
+            V = None
+            if k > 0:
+                V = (weights[tile.v_pos[:, None]
+                             + jnp.arange(k)[None, :]]
+                     * tile.v_mask[:, None])
+            return FMParams(w=w, V=V, v_mask=tile.v_mask if k else None)
+
+        def tile_grad(weights, grad, tile: Tile):
+            """objv/auc on this tile; scatter loss grad into the flat vec."""
+            params = gather_params(weights, tile)
+            pred = fm_predict(params, tile.batch)
+            objv = logit_objv(pred, tile.batch)
+            auc = auc_times_n_jnp(tile.batch.labels, pred,
+                                  tile.batch.row_mask)
+            gw, gV = fm_grad(params, tile.batch, pred)
+            grad = grad.at[tile.w_pos].add(gw)
+            if gV is not None:
+                grad = grad.at[tile.v_pos[:, None]
+                               + jnp.arange(k)[None, :]].add(
+                    gV * tile.v_mask[:, None])
+            return objv, auc, grad
+
+        def tile_pred_auc(weights, tile: Tile):
+            params = gather_params(weights, tile)
+            pred = fm_predict(params, tile.batch)
+            return auc_times_n_jnp(tile.batch.labels, pred,
+                                   tile.batch.row_mask)
+
+        def finish_grad(grad):
+            """gamma transform (CalcGrad, lbfgs_learner.cc:283-286) +
+            clear the trash region so dots/axpys see zeros there.
+            self.N is set by _init_model before the first trace."""
+            if gamma != 1:
+                grad = jnp.sign(grad) * jnp.abs(grad) ** gamma
+            return grad.at[self.N:].set(0.0)
+
+        def reg_objv(weights):
+            return 0.5 * jnp.sum(self.reg_c * weights * weights)
+
+        def reg_grad(weights):
+            return self.reg_c * weights
+
+        self._tile_grad = jax.jit(tile_grad, donate_argnums=1)
+        self._tile_pred_auc = jax.jit(tile_pred_auc)
+        self._finish_grad = jax.jit(finish_grad)
+        self._reg_objv = jax.jit(reg_objv)
+        self._reg_grad = jax.jit(reg_grad)
+        self._axpy = jax.jit(lambda a, x, y: y + a * x)
+        self._dot = jax.jit(lambda a, b: jnp.dot(a, b))
+        self._nnz = jax.jit(lambda w: jnp.sum(w != 0))
+
+    def _calc_grad(self, weights):
+        """f(w), train auc, loss gradient — one pass over train tiles."""
+        grad = jnp.zeros(self.N_pad, dtype=jnp.float32)
+        objv = 0.0
+        auc = 0.0
+        for tile in self.train_tiles:
+            o, a, grad = self._tile_grad(weights, grad, tile)
+            objv += float(o)
+            auc += float(a)
+        return objv, auc, self._finish_grad(grad)
+
+    # ----------------------------------------------------------- driver
+    def run(self) -> None:
+        """RunScheduler (lbfgs_learner.cc:14-108)."""
+        p, up = self.param, self.uparam
+        self._prepare_data()
+        self._init_model()
+        log.info("inited model with %d parameters", self.N)
+        if p.model_in:
+            n = self._warm_start(p.model_in)
+            log.info("warm start from %s: %d features matched", p.model_in, n)
+        r0 = float(self._reg_objv(self.weights))
+        f0, auc, g_loss = self._calc_grad(self.weights)
+        objv = r0 + f0
+
+        s_hist: List[jnp.ndarray] = []
+        y_hist: List[jnp.ndarray] = []
+        grads = None          # g at accepted w, incl. regularizer
+        alpha = 0.0           # server/worker alpha bookkeeping (unified)
+        val_auc_prev = 0.0
+        new_objv = objv
+
+        k = p.load_epoch if p.load_epoch >= 0 else 0
+        for epoch in range(k, p.max_num_epochs):
+            log.info("epoch %d:", epoch)
+            # kPushGradient + kPrepareCalcDirection (lbfgs_updater.h:84-99)
+            new_grads = self._axpy(1.0, self._reg_grad(self.weights), g_loss)
+            if grads is None:
+                grads = new_grads
+            else:
+                if len(y_hist) == up.m:
+                    y_hist.pop(0)
+                y_hist.append(self._axpy(-1.0, grads, new_grads))
+                grads = new_grads
+                # s_last was stored unscaled; scale by the accepted alpha
+                # (PrepareCalcDirection, lbfgs_updater.h:95-97)
+                s_hist[-1] = alpha * s_hist[-1]
+            alpha = 0.0
+
+            # kCalcDirection (lbfgs_updater.h:105-121): two-loop or -g
+            if y_hist:
+                basis = jnp.stack([*s_hist, *y_hist, grads])
+                B = np.asarray(jnp.einsum("in,jn->ij", basis, basis),
+                               dtype=np.float64)
+                from .twoloop import calc_delta
+                delta = calc_delta(B)
+                direction = jnp.asarray(delta, dtype=jnp.float32) @ basis
+            else:
+                direction = -grads
+            direction = jnp.clip(direction, -5.0, 5.0)
+            if len(s_hist) == up.m:
+                s_hist.pop(0)
+            s_hist.append(direction)
+            p_gf = float(self._dot(grads, direction))
+
+            # line search (lbfgs_learner.cc:46-71)
+            log.info(" - start linesearch with objv = %g, <p,g> = %g",
+                     objv, p_gf)
+            if epoch != 0:
+                trial = p.alpha
+            else:
+                trial = p.init_alpha if p.init_alpha > 0 \
+                    else self.ntrain / self.train_nnz
+            for i in range(p.max_num_linesearchs):
+                self.weights = self._axpy(trial - alpha, direction,
+                                          self.weights)
+                alpha = trial
+                f_new, auc, g_loss = self._calc_grad(self.weights)
+                new_objv = f_new + float(self._reg_objv(self.weights))
+                pg_new = float(self._dot(g_loss, direction)) + float(
+                    self._dot(self._reg_grad(self.weights), direction))
+                log.info(" - alpha = %g, objv = %g, <p,g> = %g",
+                         trial, new_objv, pg_new)
+                if (new_objv <= objv + p.c1 * trial * p_gf
+                        and pg_new >= p.c2 * p_gf):
+                    log.info(" - wolfe condition is satisfied")
+                    break
+                if i + 1 == p.max_num_linesearchs:
+                    log.info(" - reached max linesearch steps [%d]", i + 1)
+                trial *= p.rho
+
+            # kEvaluate (lbfgs_learner.cc:72-84)
+            val_auc = 0.0
+            for tile in self.val_tiles:
+                val_auc += float(self._tile_pred_auc(self.weights, tile))
+            prog = LBFGSProgress(
+                objv=new_objv,
+                auc=auc / max(self.ntrain, 1),
+                val_auc=val_auc / self.nval if self.nval else 0.0,
+                nnz_w=float(self._nnz(self.weights)),
+            )
+            log.info(" - training AUC = %g", prog.auc)
+            for cb in self.epoch_end_callbacks:
+                cb(epoch, prog)
+
+            # stop criteria (lbfgs_learner.cc:86-103)
+            if epoch > p.min_num_epochs:
+                eps = abs(new_objv - objv) / objv
+                if eps < p.stop_rel_objv:
+                    log.info("change of objv [%g] < stop_rel_objv", eps)
+                    break
+                if self.nval:
+                    eps = prog.val_auc - val_auc_prev
+                    if eps < p.stop_val_auc:
+                        log.info("change of val auc [%g] < stop_val_auc", eps)
+                        break
+            objv = new_objv
+            val_auc_prev = prog.val_auc
+
+        if p.model_out:
+            self.save(p.model_out)
+        log.info("training is done")
+
+    # ----------------------------------------------------------- ckpt
+    @staticmethod
+    def _ckpt_path(path: str) -> str:
+        # savez appends .npz; normalize so save(p) and load(p) round-trip
+        return path if path.endswith(".npz") else path + ".npz"
+
+    def save(self, path: str) -> None:
+        """Flat-model checkpoint (the reference LBFGSUpdater's Save/Load are
+        empty stubs, lbfgs_updater.h:22-24; we persist anyway)."""
+        np.savez_compressed(self._ckpt_path(path), feaids=self.feaids,
+                            lens=self.lens,
+                            weights=np.asarray(self.weights)[:self.N],
+                            V_dim=np.array(self.k))
+
+    def load(self, path: str) -> None:
+        with np.load(self._ckpt_path(path)) as z:
+            if int(z["V_dim"]) != self.k:
+                raise ValueError("checkpoint V_dim mismatch")
+            self.feaids = z["feaids"]
+            self.lens = z["lens"]
+            w = z["weights"]
+        offsets = np.zeros(len(self.feaids) + 1, dtype=np.int64)
+        np.cumsum(self.lens, out=offsets[1:])
+        self.offsets = offsets
+        self.N = int(offsets[-1])
+        self.N_pad = bucket(self.N + self.k + 1)
+        self.trash_w = self.N_pad - 1
+        self.trash_v = self.N_pad - 1 - self.k
+        buf = np.zeros(self.N_pad, dtype=np.float32)
+        buf[:self.N] = w
+        self.weights = jnp.asarray(buf)

@@ -1,0 +1,74 @@
+"""Vector-free L-BFGS two-loop recursion (Chen et al., NIPS'15).
+
+Equivalent of the reference's ``lbfgs::Twoloop`` (src/lbfgs/lbfgs_twoloop.h):
+the classic two-loop runs in the (2m+1)-dim basis b = [s_0..s_{m-1},
+y_0..y_{m-1}, grad] using only the Gram matrix B[i][j] = <b_i, b_j>, so the
+O(N) work is m inner products + one linear combination — on TPU one
+(2m+1, N) matmul and one matvec, with XLA psums when N is sharded.
+
+Differences from the reference (performance-only, same values):
+- B is recomputed from the basis each epoch (one einsum) instead of the
+  incremental CalcIncreB/ApplyIncreB shift bookkeeping (twoloop.h:19-66) that
+  saved network rounds in the parameter-server setting.
+- the delta coefficients are solved on the host in float64, like the
+  reference's double-precision B (twoloop.h:40).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+def calc_delta(B: np.ndarray) -> np.ndarray:
+    """Two-loop in the Gram basis (CalcDelta, lbfgs_twoloop.h:98-125).
+
+    B is (2m+1, 2m+1) float64 with basis order [s..., y..., grad]; returns
+    delta (2m+1,) such that direction p = sum_i delta_i * b_i.
+    """
+    m = (B.shape[0] - 1) // 2
+    d = np.zeros(2 * m + 1, dtype=np.float64)
+    d[2 * m] = -1.0
+    alpha = np.zeros(m, dtype=np.float64)
+    for i in range(m - 1, -1, -1):
+        alpha[i] = float(d @ B[:, i]) / (B[i, m + i] + 1e-10)
+        d[m + i] -= alpha[i]
+    d *= B[m - 1, 2 * m - 1] / (B[2 * m - 1, 2 * m - 1] + 1e-10)
+    for i in range(m):
+        beta = float(d @ B[m + i, :]) / (B[i, m + i] + 1e-10)
+        d[i] += alpha[i] - beta
+    return d
+
+
+def calc_direction(s: List[np.ndarray], y: List[np.ndarray],
+                   grad: np.ndarray) -> np.ndarray:
+    """Direction p from history + gradient (CalcDirection, twoloop.h:77-96).
+
+    Host reference implementation in float64 — the learner uses the same
+    arithmetic with jnp arrays (basis matmul for B, matvec for p).
+    """
+    assert len(s) == len(y)
+    if not s:
+        return -grad
+    basis = np.stack([*s, *y, grad]).astype(np.float64)
+    B = basis @ basis.T
+    delta = calc_delta(B)
+    return delta @ basis
+
+
+def naive_two_loop(s: List[np.ndarray], y: List[np.ndarray],
+                   grad: np.ndarray) -> np.ndarray:
+    """Textbook O(mN) two-loop (the test oracle, cf. the reference's
+    tests/cpp/lbfgs_twoloop_test.cc naive implementation)."""
+    q = grad.astype(np.float64).copy()
+    m = len(s)
+    alpha = np.zeros(m)
+    for i in range(m - 1, -1, -1):
+        alpha[i] = (s[i] @ q) / (y[i] @ s[i] + 1e-10)
+        q -= alpha[i] * y[i]
+    q *= (s[-1] @ y[-1]) / (y[-1] @ y[-1] + 1e-10)
+    for i in range(m):
+        beta = (y[i] @ q) / (y[i] @ s[i] + 1e-10)
+        q += (alpha[i] - beta) * s[i]
+    return -q

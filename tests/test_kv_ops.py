@@ -1,0 +1,96 @@
+"""KV algebra tests, mirroring the reference's kv_match/kv_union/find_position
+suites (tests/cpp/kv_match_test.cc, kv_union_test.cc, find_position_test.cc):
+random sorted-unique key sets checked against brute-force dict merges.
+"""
+
+import numpy as np
+import pytest
+
+from difacto_tpu.ops.kv import (find_position, kv_match, kv_match_varlen,
+                                kv_union)
+
+
+def gen_keys(rng, n, lo=0, hi=1000):
+    return np.unique(rng.randint(lo, hi, n).astype(np.uint64))
+
+
+def test_find_position():
+    src = np.array([2, 4, 6, 8], dtype=np.uint64)
+    dst = np.array([1, 2, 5, 6, 9], dtype=np.uint64)
+    np.testing.assert_array_equal(find_position(src, dst),
+                                  [-1, 0, -1, 2, -1])
+    # empty src
+    np.testing.assert_array_equal(
+        find_position(np.array([], dtype=np.uint64), dst), [-1] * 5)
+
+
+def test_find_position_rejects_unsorted():
+    with pytest.raises(ValueError):
+        find_position(np.array([3, 1], dtype=np.uint64),
+                      np.array([1], dtype=np.uint64))
+
+
+@pytest.mark.parametrize("val_len", [1, 3])
+@pytest.mark.parametrize("op", ["assign", "add"])
+def test_kv_match_random(val_len, op):
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        src_k = gen_keys(rng, 100)
+        dst_k = gen_keys(rng, 80)
+        src_v = rng.randn(len(src_k) * val_len).astype(np.float32)
+        dst_v = rng.randn(len(dst_k) * val_len).astype(np.float32)
+        expect = dst_v.reshape(len(dst_k), val_len).copy()
+        lut = {k: i for i, k in enumerate(src_k)}
+        nmatch = 0
+        for i, k in enumerate(dst_k):
+            if k in lut:
+                sv = src_v.reshape(-1, val_len)[lut[k]]
+                expect[i] = sv if op == "assign" else expect[i] + sv
+                nmatch += val_len
+        got = dst_v.copy()
+        n = kv_match(src_k, src_v, dst_k, got, op, val_len)
+        assert n == nmatch
+        np.testing.assert_allclose(got.reshape(-1, val_len), expect, rtol=1e-6)
+
+
+def test_kv_match_varlen():
+    """Variable lens: the [w, V...] layout (kv_match_test.cc:133)."""
+    rng = np.random.RandomState(1)
+    src_k = np.array([1, 3, 5, 7], dtype=np.uint64)
+    src_lens = np.array([1, 3, 1, 3])
+    src_v = rng.randn(int(src_lens.sum())).astype(np.float32)
+    dst_k = np.array([0, 3, 5, 8], dtype=np.uint64)
+    dst_lens = np.array([2, 3, 1, 1])
+    dst_v = np.zeros(int(dst_lens.sum()), dtype=np.float32)
+    n = kv_match_varlen(src_k, src_v, src_lens, dst_k, dst_v, dst_lens)
+    assert n == 4  # key 3 (len 3) + key 5 (len 1)
+    np.testing.assert_allclose(dst_v[2:5], src_v[1:4])  # key 3's V block
+    np.testing.assert_allclose(dst_v[5], src_v[4])      # key 5's w
+    assert (dst_v[:2] == 0).all() and dst_v[6] == 0
+
+    # length disagreement on a matched key is an error (kv_match-inl.h:100)
+    bad_lens = dst_lens.copy()
+    bad_lens[1] = 2
+    bad_v = np.zeros(int(bad_lens.sum()), dtype=np.float32)
+    with pytest.raises(ValueError):
+        kv_match_varlen(src_k, src_v, src_lens, dst_k, bad_v, bad_lens)
+
+
+@pytest.mark.parametrize("op", ["add", "assign"])
+def test_kv_union_random(op):
+    rng = np.random.RandomState(2)
+    for _ in range(5):
+        ka = gen_keys(rng, 60)
+        kb = gen_keys(rng, 60)
+        va = rng.randn(len(ka)).astype(np.float32)
+        vb = rng.randn(len(kb)).astype(np.float32)
+        keys, vals = kv_union(ka, va, kb, vb, op)
+        d = dict(zip(ka.tolist(), va.tolist()))
+        for k, v in zip(kb.tolist(), vb.tolist()):
+            if op == "add":
+                d[k] = d.get(k, 0.0) + v
+            else:
+                d[k] = v
+        assert sorted(d) == keys.tolist()
+        np.testing.assert_allclose(vals, [d[k] for k in keys.tolist()],
+                                   rtol=1e-5)
