@@ -1,6 +1,8 @@
 from .base import Learner, register
+from .bcd import BCDLearner, BCDLearnerParam, BCDProgress
 from .lbfgs import LBFGSLearner, LBFGSLearnerParam, LBFGSProgress
 from .sgd import SGDLearner, SGDLearnerParam
 
 __all__ = ["Learner", "register", "SGDLearner", "SGDLearnerParam",
-           "LBFGSLearner", "LBFGSLearnerParam", "LBFGSProgress"]
+           "LBFGSLearner", "LBFGSLearnerParam", "LBFGSProgress",
+           "BCDLearner", "BCDLearnerParam", "BCDProgress"]

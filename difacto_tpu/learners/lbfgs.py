@@ -42,7 +42,7 @@ from ..data import Reader, compact
 from ..losses import FMParams, fm_grad, fm_predict, logit_objv
 from ..losses.metrics import auc_times_n_jnp
 from ..ops.batch import DeviceBatch, bucket, pad_batch
-from ..ops.kv import find_position, kv_union
+from ..ops.kv import expand_ranges, find_position, kv_union
 from .base import Learner, register
 
 log = logging.getLogger("difacto_tpu")
@@ -219,13 +219,12 @@ class LBFGSLearner(Learner):
         np.cumsum(ck_lens, out=ck_off[1:])
         pos = find_position(ck_ids.astype(FEAID_DTYPE), self.feaids)
         ok = (pos >= 0) & (ck_lens[np.maximum(pos, 0)] == self.lens)
+        if not ok.any():
+            return 0
         src_rows = pos[ok].astype(np.int64)
         lens = self.lens[ok].astype(np.int64)
-        total = int(lens.sum())
-        rel = np.arange(total, dtype=np.int64) - np.repeat(
-            np.concatenate(([0], np.cumsum(lens[:-1]))), lens)
-        src_idx = np.repeat(ck_off[src_rows], lens) + rel
-        dst_idx = np.repeat(self.offsets[:-1][ok], lens) + rel
+        src_idx = expand_ranges(ck_off[src_rows], lens)
+        dst_idx = expand_ranges(self.offsets[:-1][ok], lens)
         w = np.asarray(self.weights).copy()
         w[dst_idx] = ck_w[src_idx]
         self.weights = jnp.asarray(w)

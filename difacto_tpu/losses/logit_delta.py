@@ -1,0 +1,53 @@
+"""Delta-form logistic loss for block coordinate descent.
+
+Equivalent of the reference's LogitLossDelta (src/loss/logit_loss_delta.h):
+consumes feature-major ("transposed") data and per-block delta weights.
+
+- ``delta_grad``: first-order gradient g = X'p with p = -y/(1+exp(y·pred))
+  and diagonal Hessian h = (X∘X)'(τ(1-τ)) (logit_loss_delta.h:90-151,
+  compute_hession=1). The reference's interleaved grad_pos/h_pos layout
+  becomes two dense block-local arrays.
+- ``delta_pred_update``: pred += X·Δw (logit_loss_delta.h:63-72).
+
+The hessian upper-bound mode (compute_hession=2) is unimplemented in the
+reference too (LOG(FATAL), logit_loss_delta.h:139-146).
+
+FMLossDelta (src/loss/fm_loss_delta.h) is an empty TODO stub in the
+reference — BCD is linear-only there and here.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class BlockSlice(NamedTuple):
+    """COO slice of one row-tile restricted to one feature block;
+    cols are block-local feature indices, padding has vals == 0."""
+    rows: jnp.ndarray  # i32[nnz_cap]
+    cols: jnp.ndarray  # i32[nnz_cap]
+    vals: jnp.ndarray  # f32[nnz_cap]
+
+
+def delta_grad(pred: jnp.ndarray, labels: jnp.ndarray, mask: jnp.ndarray,
+               blk: BlockSlice, nf_cap: int
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(g, h) over the block's features."""
+    y = jnp.where(labels > 0, 1.0, -1.0)
+    p = -y / (1.0 + jnp.exp(y * pred)) * mask
+    g = jax.ops.segment_sum(blk.vals * p[blk.rows], blk.cols,
+                            num_segments=nf_cap)
+    p2 = -p * (y * mask + p)  # tau(1-tau), zero on padding rows
+    h = jax.ops.segment_sum(blk.vals ** 2 * p2[blk.rows], blk.cols,
+                            num_segments=nf_cap)
+    return g, h
+
+
+def delta_pred_update(pred: jnp.ndarray, blk: BlockSlice,
+                      d: jnp.ndarray) -> jnp.ndarray:
+    """pred += X_blk Δw."""
+    return pred + jax.ops.segment_sum(
+        blk.vals * d[blk.cols], blk.rows, num_segments=pred.shape[0])

@@ -26,6 +26,21 @@ def _check_sorted_unique(keys: np.ndarray, name: str) -> None:
         raise ValueError(f"{name} keys must be sorted and unique")
 
 
+def expand_ranges(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Flat indices covering each [starts[i], starts[i]+lens[i]) range,
+    concatenated — the ragged-gather expansion used by variable-length KV
+    matching, warm starts, and sampled stats. Empty-safe."""
+    lens = np.asarray(lens, dtype=np.int64)
+    starts = np.asarray(starts, dtype=np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return np.zeros(0, dtype=np.int64)
+    out_starts = np.zeros(len(lens), dtype=np.int64)
+    np.cumsum(lens[:-1], out=out_starts[1:])
+    return (np.repeat(starts - out_starts, lens)
+            + np.arange(total, dtype=np.int64))
+
+
 def find_position(src_keys: np.ndarray, dst_keys: np.ndarray) -> np.ndarray:
     """int32 positions of each dst key within src (-1 if absent)."""
     _check_sorted_unique(src_keys, "src")
@@ -89,13 +104,8 @@ def kv_match_varlen(src_keys: np.ndarray, src_vals: np.ndarray,
     np.cumsum(dst_lens, out=dst_off[1:])
     lens = np.asarray(dst_lens)[hit].astype(np.int64)
     # expand each matched key's [start, start+len) value range
-    s_idx = (np.repeat(src_off[src_rows] - np.concatenate(
-        ([0], np.cumsum(lens[:-1]))), lens)
-        + np.arange(int(lens.sum()), dtype=np.int64))
-    d_start = dst_off[:-1][hit]
-    d_idx = (np.repeat(d_start - np.concatenate(
-        ([0], np.cumsum(lens[:-1]))), lens)
-        + np.arange(int(lens.sum()), dtype=np.int64))
+    s_idx = expand_ranges(src_off[src_rows], lens)
+    d_idx = expand_ranges(dst_off[:-1][hit], lens)
     if op == "assign":
         dst_vals[d_idx] = src_vals[s_idx]
     elif op == "add":

@@ -1,0 +1,89 @@
+"""BCD learner tests vs the reference goldens (tests/cpp/bcd_learner_test.cc).
+"""
+
+import numpy as np
+import pytest
+
+from difacto_tpu.learners import Learner
+from difacto_tpu.learners.bcd import fea_group_stats, partition_feature
+
+OBJV_DIAG_NEWTON = [
+    34.877064, 33.885559, 29.572740, 27.458964, 25.317689, 23.917098,
+    22.855843, 22.099876, 21.552682, 21.137216,
+]
+
+
+def run_bcd(rcv1_path, **over):
+    args = [("data_in", rcv1_path), ("l1", ".1"), ("lr", ".05"),
+            ("block_ratio", "0.001"), ("tail_feature_filter", "0"),
+            ("max_num_epochs", "10")]
+    d = dict(args)
+    d.update({k: str(v) for k, v in over.items()})
+    learner = Learner.create("bcd")
+    remain = learner.init(list(d.items()))
+    assert remain == []
+    return learner
+
+
+def test_partition_feature_single_group():
+    ranges = partition_feature(0, [(0, 4)])
+    assert len(ranges) == 4
+    # contiguous ascending cover of the keyspace
+    for i in range(1, 4):
+        assert ranges[i - 1][1] >= ranges[i][0] - 1
+        assert ranges[i - 1][0] < ranges[i][0]
+
+
+def test_partition_feature_rejects_bad_bits():
+    with pytest.raises(ValueError):
+        partition_feature(3, [(0, 1)])
+
+
+def test_fea_group_stats_sampling():
+    from difacto_tpu.data.rowblock import RowBlock
+    # 20 rows, 1 feature each; skip=10 samples rows 0 and 10
+    blk = RowBlock(offset=np.arange(21, dtype=np.int64),
+                   label=np.ones(20, dtype=np.float32),
+                   index=np.zeros(20, dtype=np.uint64))
+    v = fea_group_stats([blk], 0)
+    assert v[0] == 2      # sampled nnz
+    assert v[1] == 2      # sampled rows
+    assert v[2] == 20     # total rows
+
+
+def test_bcd_diag_newton_golden(rcv1_path):
+    """tests/cpp/bcd_learner_test.cc:9-38: single block (block_ratio=.001),
+    relative tolerance 1e-5."""
+    learner = run_bcd(rcv1_path)
+    seen = []
+    learner.add_epoch_end_callback(lambda e, p: seen.append(p.objv))
+    learner.run()
+    assert len(seen) == 10
+    rel = np.abs(np.array(seen) - np.array(OBJV_DIAG_NEWTON)) \
+        / np.array(seen)
+    assert rel.max() < 1e-5, list(zip(seen, OBJV_DIAG_NEWTON))
+
+
+@pytest.mark.parametrize("block_ratio", [0.4, 1, 10])
+def test_bcd_convergence(rcv1_path, block_ratio):
+    """tests/cpp/bcd_learner_test.cc:40-66: converges to the same optimum
+    objv 15.884923 (nnz 47) for any block partition."""
+    learner = run_bcd(rcv1_path, lr=".8", block_ratio=str(block_ratio),
+                      max_num_epochs="50")
+    last = {}
+    learner.add_epoch_end_callback(lambda e, p: last.update(p=p))
+    learner.run()
+    assert abs(last["p"].objv - 15.884923) / last["p"].objv < 1e-3
+    assert last["p"].nnz_w == 47
+
+
+def test_bcd_save_load(rcv1_path, tmp_path):
+    m = str(tmp_path / "bcd_model")
+    learner = run_bcd(rcv1_path, max_num_epochs="5", model_out=m)
+    learner.run()
+    l2 = run_bcd(rcv1_path, max_num_epochs="1", model_in=m)
+    seen = []
+    l2.add_epoch_end_callback(lambda e, p: seen.append(p.objv))
+    l2.run()
+    # warm-started epoch continues below the cold epoch-0 objective
+    assert seen[0] < OBJV_DIAG_NEWTON[0]

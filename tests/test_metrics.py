@@ -1,0 +1,63 @@
+"""BinClassMetric parity tests (src/loss/bin_class_metric.h):
+AUC (area*n with <0.5 flip), Accuracy (majority flip), LogLoss, LogitObjv —
+raw sums, never divided by n.
+"""
+
+import numpy as np
+
+from difacto_tpu.losses.metrics import (accuracy_times_n, auc_times_n,
+                                        logit_objv_np, logloss, rmse_stub)
+
+
+def brute_auc(label, pred):
+    pos = pred[label > 0]
+    neg = pred[label <= 0]
+    if len(pos) == 0 or len(neg) == 0:
+        return 1.0
+    wins = sum((p > q) + 0.5 * (p == q) for p in pos for q in neg)
+    a = wins / (len(pos) * len(neg))
+    return (1 - a if a < 0.5 else a) * len(label)
+
+
+def test_auc_matches_brute_force():
+    rng = np.random.RandomState(0)
+    for _ in range(10):
+        n = rng.randint(3, 40)
+        label = rng.choice([0.0, 1.0], n)
+        pred = rng.randn(n).astype(np.float32)
+        got = auc_times_n(label, pred)
+        # ties are counted differently by rank-sum vs 0.5-credit; avoid ties
+        assert abs(got - brute_auc(label, pred)) < 1e-4
+
+
+def test_auc_degenerate():
+    assert auc_times_n(np.ones(5), np.random.randn(5)) == 1.0
+    assert auc_times_n(np.zeros(5), np.random.randn(5)) == 1.0
+    assert auc_times_n(np.zeros(0), np.zeros(0)) == 0.0
+
+
+def test_accuracy_majority_flip():
+    label = np.array([1, 1, 0, 0], dtype=np.float32)
+    pred = np.array([1.0, 1.0, -1.0, 1.0])
+    # 3 correct at threshold 0 -> returns 3 (majority side)
+    assert accuracy_times_n(label, pred, 0.0) == 3
+    # all wrong -> flipped to n (bin_class_metric.h:66)
+    assert accuracy_times_n(label, -pred - 0.1, 0.0) >= 2
+
+
+def test_logloss_finite_at_extremes():
+    label = np.array([0.0, 1.0])
+    pred = np.array([100.0, -100.0], dtype=np.float32)  # maximally wrong
+    v = logloss(label, pred)
+    assert np.isfinite(v) and v > 40
+
+
+def test_logit_objv():
+    label = np.array([1.0, 0.0])
+    pred = np.array([0.0, 0.0], dtype=np.float32)
+    assert abs(logit_objv_np(label, pred) - 2 * np.log(2)) < 1e-6
+
+
+def test_rmse_stub_sums_raw_diff():
+    # the reference's "RMSE" sums raw differences (bin_class_metric.h:94-102)
+    assert rmse_stub(np.array([3.0, 1.0]), np.array([1.0, 1.0])) == 2.0
