@@ -223,9 +223,15 @@ class SGDLearner(Learner):
             reader = Reader(p.data_val or p.data_in, p.data_format, part_idx,
                             num_parts, chunk_bytes=256 << 20)
 
+        def produce():
+            # parsing + localization on the producer thread; store access
+            # (key mapping, state) stays on the consumer side
+            for blk in reader:
+                yield blk, compact(blk, need_counts=push_cnt)
+
+        from ..data.prefetch import prefetch
         pending: list = []  # device scalars fetched lazily at the end
-        for blk in reader:
-            cblk, uniq, cnts = compact(blk, need_counts=push_cnt)
+        for blk, (cblk, uniq, cnts) in prefetch(produce(), depth=2):
             u_cap = bucket(len(uniq))
             slots_np = self.store.map_keys(uniq)
             slots = self.store.pad_slots(slots_np, u_cap)

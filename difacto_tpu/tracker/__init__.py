@@ -1,0 +1,6 @@
+"""Control plane: job tracking, workload distribution, failure handling."""
+
+from .async_tracker import AsyncTracker
+from .workload_pool import WorkloadPool, WorkloadPoolParam
+
+__all__ = ["AsyncTracker", "WorkloadPool", "WorkloadPoolParam"]

@@ -1,0 +1,120 @@
+"""Async job tracker: single-consumer queue with callbacks and a monitor.
+
+Equivalent of the reference's AsyncLocalTracker<Job, Result>
+(src/tracker/async_local_tracker.h:28-151) — the backbone of both the local
+Tracker and the worker's in-flight minibatch pipeline. An executor thread
+drains the queue; each job's result flows to its ``on_complete`` callback and
+to the tracker-wide monitor. ``num_remains`` drives bounded-in-flight
+backpressure (the <=2 pipelined minibatches, sgd_learner.cc:310-312).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, List, Optional, Tuple
+
+
+class AsyncTracker:
+    def __init__(self) -> None:
+        self._mu = threading.Condition()
+        self._pending: deque = deque()
+        self._running = 0
+        self._executor: Optional[Callable[[Any], Any]] = None
+        self._monitor: Optional[Callable[[Any, Any], None]] = None
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    # ------------------------------------------------------------ producer
+    def issue(self, job: Any,
+              on_complete: Optional[Callable[[Any], None]] = None) -> None:
+        if self._thread is None:
+            raise RuntimeError("set_executor must be called before issue")
+        with self._mu:
+            if self._error is not None:
+                raise RuntimeError("executor failed") from self._error
+            self._pending.append((job, on_complete))
+            self._mu.notify_all()
+
+    def issue_and_wait(self, jobs: List[Any]) -> List[Any]:
+        results: List[Any] = [None] * len(jobs)
+        remain = [len(jobs)]
+        done = threading.Condition()
+
+        def make_cb(i):
+            def cb(res):
+                results[i] = res
+                with done:
+                    remain[0] -= 1
+                    done.notify_all()
+            return cb
+
+        for i, j in enumerate(jobs):
+            self.issue(j, make_cb(i))
+        with done:
+            done.wait_for(lambda: remain[0] == 0)
+        self._reraise()
+        return results
+
+    def num_remains(self) -> int:
+        with self._mu:
+            return len(self._pending) + self._running
+
+    def wait(self) -> None:
+        """Block until the queue drains (Wait, async_local_tracker.h:77-85)."""
+        with self._mu:
+            self._mu.wait_for(
+                lambda: (not self._pending and self._running == 0)
+                or self._error is not None)
+        self._reraise()
+
+    def _reraise(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("tracker executor failed") from err
+
+    # ------------------------------------------------------------ executor
+    def set_executor(self, fn: Callable[[Any], Any]) -> None:
+        self._executor = fn
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def set_monitor(self, fn: Callable[[Any, Any], None]) -> None:
+        self._monitor = fn
+
+    def stop(self) -> None:
+        with self._mu:
+            self._stop = True
+            self._mu.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            with self._mu:
+                self._mu.wait_for(lambda: self._pending or self._stop)
+                if self._stop and not self._pending:
+                    return
+                job, cb = self._pending.popleft()
+                self._running += 1
+            try:
+                res = self._executor(job)
+                if self._monitor is not None:
+                    self._monitor(job, res)
+                if cb is not None:
+                    cb(res)
+            except BaseException as e:  # surfaced on wait/issue_and_wait
+                with self._mu:
+                    self._error = e
+                if cb is not None:
+                    try:
+                        cb(None)  # unblock waiters; _reraise surfaces the error
+                    except BaseException:
+                        pass
+            finally:
+                with self._mu:
+                    self._running -= 1
+                    self._mu.notify_all()

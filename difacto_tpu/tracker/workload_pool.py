@@ -1,0 +1,146 @@
+"""Scheduler-side workload pool: file-part assignment with failure handling.
+
+Equivalent of the reference's WorkloadPool (src/reader/workload_pool.h:28-203)
+— the host-side half of data parallelism. Parts (byte ranges of the input,
+data/reader.py) are handed to nodes (hosts / pipeline threads) on request;
+the pool
+
+- re-queues the in-flight parts of a dead node (``reset``,
+  workload_pool.h:88-105 Set(del=false)),
+- re-issues parts running longer than max(10 x mean, straggler_timeout)
+  once >= 10 completion times are known (``remove_stragglers``,
+  workload_pool.h:155-176),
+- optionally picks parts at random (``wl_shuffle``).
+
+Thread-safe; the straggler check is called by the owner (no daemon thread —
+the caller's dispatch loop invokes ``remove_stragglers`` periodically, which
+keeps tests deterministic; the reference used a 2 s poller thread).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time as _time
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional
+
+from ..config import Param
+
+log = logging.getLogger("difacto_tpu")
+
+
+@dataclass
+class WorkloadPoolParam(Param):
+    straggler_timeout: float = 0.0  # 0 disables straggler re-issue
+    wl_shuffle: bool = False
+    seed: int = 0
+
+
+class _Assigned(NamedTuple):
+    node: int
+    part: int
+    start: float
+
+
+class WorkloadPool:
+    def __init__(self, param: Optional[WorkloadPoolParam] = None):
+        self.param = param or WorkloadPoolParam()
+        self._mu = threading.Lock()
+        self._avail: Dict[int, bool] = {}   # part -> available
+        self._assigned: List[_Assigned] = []
+        self._times: List[float] = []
+        self._num_finished = 0
+        self._inited = False
+        if self.param.wl_shuffle:
+            import random
+            self._rng = random.Random(self.param.seed)
+
+    def add(self, num_parts: int) -> None:
+        with self._mu:
+            self._avail = {i: True for i in range(num_parts)}
+            self._inited = True
+
+    def clear(self) -> None:
+        with self._mu:
+            self._avail.clear()
+            self._assigned.clear()
+            self._times.clear()
+            self._num_finished = 0
+            self._inited = False
+
+    @property
+    def inited(self) -> bool:
+        return self._inited
+
+    def get(self, node: int) -> int:
+        """Next part for ``node``; -2 when nothing is available
+        (GetOne, workload_pool.h:124-152)."""
+        with self._mu:
+            avail = [k for k, a in self._avail.items() if a]
+            if not avail:
+                return -2
+            part = (self._rng.choice(avail) if self.param.wl_shuffle
+                    else avail[0])
+            self._avail[part] = False
+            self._assigned.append(_Assigned(node, part, _time.time()))
+            return part
+
+    def finish(self, node: int) -> None:
+        """All of node's in-flight parts completed."""
+        self._set(node, done=True)
+
+    def reset(self, node: int) -> None:
+        """Node died: its in-flight parts go back to the pool."""
+        self._set(node, done=False)
+
+    def _set(self, node: int, done: bool) -> None:
+        with self._mu:
+            rest = []
+            for a in self._assigned:
+                if a.node != node:
+                    rest.append(a)
+                    continue
+                if done:
+                    self._times.append(_time.time() - a.start)
+                    self._avail.pop(a.part, None)
+                    self._num_finished += 1
+                else:
+                    self._avail[a.part] = True
+                    log.info("%d failed to finish part %d", node, a.part)
+            self._assigned = rest
+
+    def num_remains(self) -> int:
+        """Unfinished parts: available + in-flight, each counted once."""
+        with self._mu:
+            return (sum(1 for a in self._avail.values() if a)
+                    + len(self._assigned))
+
+    @property
+    def num_finished(self) -> int:
+        return self._num_finished
+
+    def remove_stragglers(self, now: Optional[float] = None) -> List[int]:
+        """Re-queue parts exceeding max(10 x mean, straggler_timeout);
+        needs >= 10 completion samples (RemoveStraggler,
+        workload_pool.h:155-176). Returns the re-queued part ids."""
+        if not self.param.straggler_timeout:
+            return []
+        with self._mu:
+            if len(self._times) < 10:
+                return []
+            mean = sum(self._times) / len(self._times)
+            limit = max(mean * 10, self.param.straggler_timeout)
+            now = _time.time() if now is None else now
+            rest, requeued = [], []
+            for a in self._assigned:
+                if now - a.start > limit:
+                    log.info("part %d on %d ran %.1fs (mean %.1fs); "
+                             "re-issuing", a.part, a.node, now - a.start,
+                             mean)
+                    self._avail[a.part] = True
+                    requeued.append(a.part)
+                else:
+                    rest.append(a)
+            self._assigned = rest
+            return requeued

@@ -1,0 +1,133 @@
+"""Control-plane tests: WorkloadPool (assignment, dead-node reset, straggler
+re-issue — src/reader/workload_pool.h), AsyncTracker (async_local_tracker.h),
+Reporter, and the prefetcher."""
+
+import time
+
+import numpy as np
+import pytest
+
+from difacto_tpu.data.prefetch import prefetch
+from difacto_tpu.tracker import AsyncTracker, WorkloadPool, WorkloadPoolParam
+from difacto_tpu.utils.reporter import Reporter
+
+
+def test_pool_assign_finish():
+    pool = WorkloadPool()
+    pool.add(3)
+    assert pool.num_remains() == 3
+    parts = [pool.get(node=1), pool.get(node=1), pool.get(node=2)]
+    assert sorted(parts) == [0, 1, 2]
+    assert pool.get(node=3) == -2  # exhausted
+    pool.finish(1)  # both of node 1's parts
+    assert pool.num_remains() == 1
+    assert pool.num_finished == 2
+    pool.finish(2)
+    assert pool.num_remains() == 0
+
+
+def test_pool_dead_node_reassign():
+    """Reset re-queues a dead node's in-flight parts (Set(del=false))."""
+    pool = WorkloadPool()
+    pool.add(2)
+    p = pool.get(node=7)
+    pool.reset(node=7)
+    assert pool.num_remains() == 2
+    # the part is available again, another node can take it
+    got = {pool.get(node=8), pool.get(node=8)}
+    assert p in got
+
+
+def test_pool_straggler_reissue():
+    pool = WorkloadPool(WorkloadPoolParam(straggler_timeout=0.01))
+    pool.add(12)
+    # 10 fast completions establish the mean
+    for i in range(10):
+        pool.get(node=1)
+        pool.finish(1)
+    slow = pool.get(node=2)
+    # pretend the slow part has been running far past the threshold
+    requeued = pool.remove_stragglers(now=time.time() + 3600)
+    assert requeued == [slow]
+    assert pool.get(node=3) == slow  # re-issued to another node
+
+
+def test_pool_straggler_needs_history():
+    pool = WorkloadPool(WorkloadPoolParam(straggler_timeout=0.01))
+    pool.add(2)
+    pool.get(node=1)
+    assert pool.remove_stragglers(now=time.time() + 3600) == []
+
+
+def test_async_tracker_exec_and_monitor():
+    tr = AsyncTracker()
+    seen = []
+    tr.set_executor(lambda j: j * 2)
+    tr.set_monitor(lambda job, res: seen.append((job, res)))
+    assert tr.issue_and_wait([1, 2, 3]) == [2, 4, 6]
+    assert sorted(seen) == [(1, 2), (2, 4), (3, 6)]
+    tr.stop()
+
+
+def test_async_tracker_backpressure_and_wait():
+    tr = AsyncTracker()
+    tr.set_executor(lambda j: time.sleep(0.01) or j)
+    for i in range(5):
+        tr.issue(i)
+    assert tr.num_remains() > 0
+    tr.wait()
+    assert tr.num_remains() == 0
+    tr.stop()
+
+
+def test_async_tracker_error_propagates():
+    tr = AsyncTracker()
+    tr.set_executor(lambda j: 1 / 0)
+    tr.issue(1)
+    with pytest.raises(RuntimeError):
+        tr.wait()
+    tr.stop()
+
+
+def test_async_tracker_error_unblocks_issue_and_wait():
+    tr = AsyncTracker()
+    tr.set_executor(lambda j: 1 / 0)
+    with pytest.raises(RuntimeError):
+        tr.issue_and_wait([1, 2])  # must raise, not deadlock
+    tr.stop()
+
+
+def test_reporter_throttle():
+    rep = Reporter(every=50)
+    got = []
+    rep.set_monitor(lambda node, p: got.append(p))
+    for i in range(120):
+        rep.report(i)
+    assert got == [49, 99]  # every 50th report
+
+
+def test_prefetch_order_and_errors():
+    assert list(prefetch(iter(range(100)), depth=2)) == list(range(100))
+
+    def bad():
+        yield 1
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError):
+        list(prefetch(bad()))
+
+
+def test_prefetch_overlaps(rcv1_path):
+    """The prefetched SGD epoch produces the identical trajectory."""
+    from difacto_tpu.learners import Learner
+    args = [("data_in", rcv1_path), ("V_dim", "0"), ("l2", "1"), ("l1", "1"),
+            ("lr", "1"), ("num_jobs_per_epoch", "1"), ("batch_size", "100"),
+            ("max_num_epochs", "3"), ("shuffle", "0"),
+            ("report_interval", "0"), ("stop_rel_objv", "0")]
+    learner = Learner.create("sgd")
+    learner.init(list(args))
+    seen = []
+    learner.add_epoch_end_callback(lambda e, t, v: seen.append(t.loss))
+    learner.run()
+    np.testing.assert_allclose(
+        seen, [69.314718, 69.314718, 67.151912], atol=5e-5)
