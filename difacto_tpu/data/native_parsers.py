@@ -1,0 +1,53 @@
+"""ctypes bridge: native libsvm parsing into RowBlocks.
+
+Falls back to the pure-Python parser (parsers.parse_libsvm) when the native
+library is unavailable; both produce identical RowBlocks (tests compare them
+byte for byte).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ..base import FEAID_DTYPE, REAL_DTYPE
+from ..native import get_lib
+from .rowblock import RowBlock, empty_block
+
+
+def parse_libsvm_native(chunk: bytes) -> RowBlock:
+    lib = get_lib()
+    if lib is None:
+        from .parsers import parse_libsvm
+        return parse_libsvm(chunk)
+
+    max_rows = chunk.count(b"\n") + 2
+    max_nnz = chunk.count(b":") + 1
+    labels = np.empty(max_rows, dtype=REAL_DTYPE)
+    offset = np.empty(max_rows + 1, dtype=np.int64)
+    index = np.empty(max_nnz, dtype=FEAID_DTYPE)
+    value = np.empty(max_nnz, dtype=REAL_DTYPE)
+    out_rows = ctypes.c_int64()
+    out_nnz = ctypes.c_int64()
+    out_has_value = ctypes.c_int()
+
+    rc = lib.difacto_parse_libsvm(
+        chunk, len(chunk),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        offset.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        index.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        value.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.byref(out_rows), ctypes.byref(out_nnz),
+        ctypes.byref(out_has_value))
+    if rc != 0:
+        raise ValueError("malformed libsvm chunk")
+    n, nnz = out_rows.value, out_nnz.value
+    if n == 0:
+        return empty_block()
+    return RowBlock(
+        offset=offset[:n + 1].copy(),
+        label=labels[:n].copy(),
+        index=index[:nnz].copy(),
+        value=value[:nnz].copy() if out_has_value.value else None,
+    )

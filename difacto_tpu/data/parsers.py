@@ -145,7 +145,9 @@ def parse_adfea(chunk: bytes) -> RowBlock:
 def get_parser(fmt: str):
     fmt = fmt.lower()
     if fmt == "libsvm":
-        return parse_libsvm
+        # native C++ fast path with automatic Python fallback
+        from .native_parsers import parse_libsvm_native
+        return parse_libsvm_native
     if fmt == "criteo":
         return parse_criteo
     if fmt == "criteo_test":

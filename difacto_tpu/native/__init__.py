@@ -1,0 +1,74 @@
+"""Native (C++) kernels for the host-side data path.
+
+The TPU compute path is JAX/XLA; the host runtime around it (parsing, IO)
+uses C++ where the reference did (dmlc-core's parsers are C++ too). Build is
+lazy and cached: first use compiles the shared library with g++ next to this
+package; any failure falls back to the pure-Python implementations, so the
+framework never hard-requires a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger("difacto_tpu")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "_difacto_native.so")
+_SRC = [os.path.join(_DIR, "libsvm_parser.cc")]
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           "-o", _SO + ".tmp"] + _SRC
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.info("native build skipped (%s); using Python fallbacks", e)
+        return False
+
+
+def _newest_src_mtime() -> float:
+    return max(os.path.getmtime(s) for s in _SRC)
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if
+    unavailable (callers must fall back to Python)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _tried:
+            return None
+        _tried = True
+        stale = (not os.path.exists(_SO)
+                 or os.path.getmtime(_SO) < _newest_src_mtime())
+        if stale and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:
+            log.info("native load failed (%s); using Python fallbacks", e)
+            return None
+        lib.difacto_parse_libsvm.restype = ctypes.c_int
+        lib.difacto_parse_libsvm.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        _lib = lib
+        return _lib

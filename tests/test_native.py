@@ -1,0 +1,83 @@
+"""Native libsvm parser: byte-for-byte equivalence with the Python parser
+and a throughput sanity check."""
+
+import numpy as np
+import pytest
+
+from difacto_tpu.data.parsers import parse_libsvm
+from difacto_tpu.data.native_parsers import parse_libsvm_native
+from difacto_tpu.native import get_lib
+
+needs_native = pytest.mark.skipif(get_lib() is None,
+                                  reason="native toolchain unavailable")
+
+
+@needs_native
+def test_native_matches_python_on_fixture(rcv1_path):
+    chunk = open(rcv1_path, "rb").read()
+    a = parse_libsvm(chunk)
+    b = parse_libsvm_native(chunk)
+    assert a.size == b.size == 100
+    np.testing.assert_array_equal(a.offset, b.offset)
+    np.testing.assert_array_equal(a.label, b.label)
+    np.testing.assert_array_equal(a.index, b.index)
+    np.testing.assert_allclose(a.values_or_ones(), b.values_or_ones(),
+                               rtol=1e-6)
+
+
+@needs_native
+def test_native_edge_cases():
+    # empty chunk, blank lines, no-feature rows, binary values, \r\n
+    cases = [
+        b"",
+        b"\n\n\n",
+        b"1\n0\n",                       # label-only rows
+        b"1 5:1 7:1\n0 2:1\n",           # all-ones -> value elided
+        b"-1 3:0.5 9:2.25\r\n+1 1:1e-3\r\n",
+        b"0.5 18446744073709551615:4\n",  # uint64 max feature id
+    ]
+    for chunk in cases:
+        a = parse_libsvm(chunk)
+        b = parse_libsvm_native(chunk)
+        assert a.size == b.size, chunk
+        np.testing.assert_array_equal(a.offset, b.offset)
+        np.testing.assert_array_equal(a.label, b.label)
+        np.testing.assert_array_equal(a.index, b.index)
+        np.testing.assert_allclose(a.values_or_ones(), b.values_or_ones(),
+                                   rtol=1e-6, err_msg=str(chunk))
+    # binary elision: all values 1 -> value is None
+    assert parse_libsvm_native(b"1 5:1 7:1\n").value is None
+    assert parse_libsvm_native(b"1 5:2\n").value is not None
+
+
+@needs_native
+def test_native_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_libsvm_native(b"1 nocolon\n")
+    # empty value must not swallow the next line's label (strtof skips \n)
+    with pytest.raises(ValueError):
+        parse_libsvm_native(b"1 5:\n0 3:1\n")
+    # negative index must not wrap to a huge uint64
+    with pytest.raises(ValueError):
+        parse_libsvm_native(b"1 -5:2\n")
+
+
+@needs_native
+def test_native_is_faster(rcv1_path):
+    import time
+    chunk = open(rcv1_path, "rb").read() * 50  # ~5000 rows
+    t0 = time.perf_counter()
+    parse_libsvm(chunk)
+    py = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    parse_libsvm_native(chunk)
+    native = time.perf_counter() - t0
+    assert native < py, (native, py)  # typically 10-30x faster
+
+
+@needs_native
+def test_reader_uses_native(rcv1_path):
+    """End to end: the Reader path produces the same 100 rows."""
+    from difacto_tpu.data import Reader
+    blocks = list(Reader(rcv1_path, "libsvm"))
+    assert sum(b.size for b in blocks) == 100
