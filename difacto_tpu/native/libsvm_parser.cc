@@ -14,15 +14,25 @@
 // *out_has_value = 0 when every value == 1.0 (binary elision,
 // src/reader/batch_reader.cc:71-73 drops such arrays).
 
+#include <cctype>
+#include <cerrno>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <locale.h>
 
 namespace {
 
 inline const char* skip_ws(const char* p, const char* end) {
   while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
   return p;
+}
+
+// strtof/strtoull honor LC_NUMERIC; parse with a fixed "C" locale so a
+// comma-decimal host locale can't make well-formed files unparseable
+locale_t c_locale() {
+  static locale_t loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  return loc;
 }
 
 }  // namespace
@@ -44,7 +54,7 @@ extern "C" int difacto_parse_libsvm(
 
     // label
     char* next = nullptr;
-    float lab = strtof(p, &next);
+    float lab = strtof_l(p, &next, c_locale());
     if (next == p) return -1;
     p = next;
     labels[rows] = lab;
@@ -54,14 +64,15 @@ extern "C" int difacto_parse_libsvm(
       p = skip_ws(p, end);
       if (p >= end || *p == '\n') { if (p < end) ++p; break; }
       if (*p == '-') return -1;  // strtoull would silently wrap negatives
-      uint64_t idx = strtoull(p, &next, 10);
+      errno = 0;
+      uint64_t idx = strtoull_l(p, &next, 10, c_locale());
       if (next == p || next >= end || *next != ':') return -1;
+      if (errno == ERANGE) return -1;  // id > uint64 max must not clamp
       p = next + 1;
       // the value must start right after ':' — strtof skips whitespace
       // (incl. '\n') and would otherwise swallow the next line's label
-      if (p >= end || *p == ' ' || *p == '\t' || *p == '\r' || *p == '\n')
-        return -1;
-      float val = strtof(p, &next);
+      if (p >= end || isspace((unsigned char)*p)) return -1;
+      float val = strtof_l(p, &next, c_locale());
       if (next == p) return -1;
       p = next;
       index[nnz] = idx;

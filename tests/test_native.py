@@ -60,19 +60,31 @@ def test_native_rejects_malformed():
     # negative index must not wrap to a huge uint64
     with pytest.raises(ValueError):
         parse_libsvm_native(b"1 -5:2\n")
+    # exotic whitespace after ':' must not swallow the next line either
+    with pytest.raises(ValueError):
+        parse_libsvm_native(b"1 5:\x0c\n0 3:1\n")
+    # id one past uint64 max must error, not clamp
+    with pytest.raises(ValueError):
+        parse_libsvm_native(b"1 18446744073709551616:1\n")
 
 
 @needs_native
 def test_native_is_faster(rcv1_path):
     import time
     chunk = open(rcv1_path, "rb").read() * 50  # ~5000 rows
-    t0 = time.perf_counter()
-    parse_libsvm(chunk)
-    py = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    parse_libsvm_native(chunk)
-    native = time.perf_counter() - t0
-    assert native < py, (native, py)  # typically 10-30x faster
+
+    def best_of(f, n=3):
+        times = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            f(chunk)
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    py = best_of(parse_libsvm)
+    native = best_of(parse_libsvm_native)
+    # typically 10-30x faster; generous bound to stay robust under CI load
+    assert native < py * 0.8, (native, py)
 
 
 @needs_native
