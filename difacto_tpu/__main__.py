@@ -16,6 +16,7 @@ Unknown leftover keys warn, as in main.cc:40-46.
 from __future__ import annotations
 
 import logging
+import os
 import sys
 from dataclasses import dataclass, field
 
@@ -74,6 +75,10 @@ def main(argv: list[str] | None = None) -> int:
         print("usage: python -m difacto_tpu config_file key1=val1 ...",
               file=sys.stderr)
         return 1
+
+    if "DIFACTO_NPROCS" in os.environ:
+        from .parallel.multihost import initialize
+        initialize()
 
     kwargs = parse_cli_args(argv)
     param, remain = DifactoParam.init_allow_unknown(kwargs)

@@ -1,0 +1,144 @@
+"""Keyed host array store + tile cache for blocked training data.
+
+Equivalents of the reference's src/data layer:
+
+- :class:`DataStore` <- DataStore/DataStoreMemory (src/data/data_store.h:
+  24-163): keyed typed arrays with range fetch and a prefetch hint. The
+  reference's disk-spill class is an empty stub (DataStoreDisk,
+  src/data/data_store_impl.h:77-83); here spilling actually works — set
+  ``max_mem_bytes`` and least-recently-used entries are written to
+  ``spill_dir`` as .npy files and reloaded on demand.
+- :class:`TileCache` <- TileStore (src/data/tile_store.h:32-168): a
+  (rowblk, colblk)-keyed cache of *built* tiles (for us: device-resident
+  COO slices) with LRU eviction, so feature-blocked learners (BCD, L-BFGS)
+  can cap device/host memory on > memory datasets and rebuild evicted
+  tiles on demand. ``prefetch`` builds ahead, mirroring
+  TileStore::Prefetch's hint semantics.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Hashable, Optional, Tuple
+
+import numpy as np
+
+
+class DataStore:
+    """Host store of named numpy arrays with optional LRU disk spill."""
+
+    def __init__(self, max_mem_bytes: int = 0,
+                 spill_dir: Optional[str] = None):
+        self._mem: "OrderedDict[str, np.ndarray]" = OrderedDict()
+        self._meta: Dict[str, Tuple[tuple, np.dtype]] = {}
+        self._spilled: Dict[str, str] = {}
+        self.max_mem_bytes = max_mem_bytes
+        self.spill_dir = spill_dir
+        if max_mem_bytes and not spill_dir:
+            raise ValueError("max_mem_bytes requires spill_dir")
+
+    def store(self, key: str, data: np.ndarray) -> None:
+        data = np.asarray(data)
+        self._meta[key] = (data.shape, data.dtype)
+        self._drop_spill(key)
+        self._mem[key] = data
+        self._mem.move_to_end(key)
+        self._maybe_spill()
+
+    def fetch(self, key: str, begin: int = 0,
+              end: Optional[int] = None) -> np.ndarray:
+        """The [begin, end) row range of key (Fetch, data_store.h:77-96)."""
+        if key not in self._meta:
+            raise KeyError(key)
+        arr = self._mem.get(key)
+        if arr is None:
+            arr = np.load(self._spilled[key])
+            self._mem[key] = arr
+            self._drop_spill(key)  # remove the .npy, not just the entry
+            self._maybe_spill()
+        self._mem.move_to_end(key)
+        return arr[begin:end] if (begin or end is not None) else arr
+
+    def prefetch(self, key: str, begin: int = 0,
+                 end: Optional[int] = None) -> None:
+        """Hint: pull a spilled entry back into memory."""
+        if key in self._spilled:
+            self.fetch(key, begin, end)
+
+    def remove(self, key: str) -> None:
+        self._meta.pop(key, None)
+        self._mem.pop(key, None)
+        self._drop_spill(key)
+
+    def size(self, key: str) -> int:
+        shape, _ = self._meta[key]
+        return int(np.prod(shape)) if shape else 1
+
+    def keys(self):
+        return list(self._meta)
+
+    # ------------------------------------------------------------- spill
+    def _mem_bytes(self) -> int:
+        return sum(a.nbytes for a in self._mem.values())
+
+    def _drop_spill(self, key: str) -> None:
+        path = self._spilled.pop(key, None)
+        if path:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def _maybe_spill(self) -> None:
+        if not self.max_mem_bytes:
+            return
+        os.makedirs(self.spill_dir, exist_ok=True)
+        while self._mem_bytes() > self.max_mem_bytes and len(self._mem) > 1:
+            key, arr = self._mem.popitem(last=False)  # least recently used
+            # unique monotone filename — hash(key) could collide
+            self._spill_seq = getattr(self, "_spill_seq", 0) + 1
+            path = os.path.join(self.spill_dir,
+                                f"spill-{self._spill_seq:08d}.npy")
+            np.save(path, arr)
+            self._spilled[key] = path
+
+
+class TileCache:
+    """LRU cache of built tiles keyed by (rowblk_id, colblk_id).
+
+    ``build(rowblk_id, colblk_id)`` constructs a tile (host or device
+    object); ``max_items=0`` means unlimited. ``None`` results (empty
+    tiles) are cached too.
+    """
+
+    def __init__(self, build: Callable[[Hashable, Hashable], Any],
+                 max_items: int = 0):
+        self._build = build
+        self._cache: "OrderedDict[Tuple[Hashable, Hashable], Any]" \
+            = OrderedDict()
+        self.max_items = max_items
+        self.hits = 0
+        self.misses = 0
+
+    def fetch(self, rowblk_id: Hashable, colblk_id: Hashable) -> Any:
+        key = (rowblk_id, colblk_id)
+        if key in self._cache:
+            self._cache.move_to_end(key)
+            self.hits += 1
+            return self._cache[key]
+        self.misses += 1
+        tile = self._build(rowblk_id, colblk_id)
+        self._cache[key] = tile
+        if self.max_items and len(self._cache) > self.max_items:
+            self._cache.popitem(last=False)
+        return tile
+
+    def prefetch(self, rowblk_id: Hashable, colblk_id: Hashable) -> None:
+        self.fetch(rowblk_id, colblk_id)
+
+    def invalidate(self) -> None:
+        self._cache.clear()
+
+    def __len__(self) -> int:
+        return len(self._cache)

@@ -65,6 +65,9 @@ class BCDLearnerParam(Param):
     neg_sampling: float = 1.0  # declared but unused in the reference too
     data_chunk_size: int = 1 << 28  # bytes
     seed: int = 0
+    # device-tile cache bound (0 = keep every (tile, block) slice resident);
+    # the reference's analog is TileStore's cache over DataStore
+    tile_cache_items: int = 0
 
 
 @dataclass
@@ -237,18 +240,18 @@ class BCDLearner(Learner):
                 labels=jnp.asarray(labels),
                 mask=jnp.asarray(mask),
                 pred=jnp.zeros(b_cap, dtype=jnp.float32),
-                slices={},
             ))
+        from ..data.tile_store import TileCache
+        self._tile_cache = TileCache(self._build_slice,
+                                     max_items=p.tile_cache_items)
 
-    def _block_slice(self, tile, f: int) -> Optional[_BlockSlice]:
-        """COO of tile columns in block f (block-local ids), cached."""
-        if f in tile["slices"]:
-            return tile["slices"][f]
+    def _build_slice(self, t: int, f: int) -> Optional[_BlockSlice]:
+        """Device COO of tile t's columns in block f (block-local ids)."""
+        tile = self.tiles[t]
         b_lo, b_hi = self.blocks[f]
         m = (tile["col_global"] >= b_lo) & (tile["col_global"] < b_hi)
         nnz = int(m.sum())
         if nnz == 0:
-            tile["slices"][f] = None
             return None
         cap = bucket(nnz)
         rows = np.zeros(cap, dtype=np.int32)
@@ -257,10 +260,11 @@ class BCDLearner(Learner):
         cols[:nnz] = tile["col_global"][m] - b_lo
         vals = np.zeros(cap, dtype=np.float32)
         vals[:nnz] = tile["vals"][m]
-        s = _BlockSlice(rows=jnp.asarray(rows), cols=jnp.asarray(cols),
-                        vals=jnp.asarray(vals))
-        tile["slices"][f] = s
-        return s
+        return _BlockSlice(rows=jnp.asarray(rows), cols=jnp.asarray(cols),
+                           vals=jnp.asarray(vals))
+
+    def _block_slice(self, t: int, f: int) -> Optional[_BlockSlice]:
+        return self._tile_cache.fetch(t, f)
 
     # ----------------------------------------------------------- epoch
     def _iterate_block(self, f: int) -> None:
@@ -272,10 +276,10 @@ class BCDLearner(Learner):
 
         g = jnp.zeros(nf_cap, dtype=jnp.float32)
         h = jnp.zeros(nf_cap, dtype=jnp.float32)
-        for tile in self.tiles:
+        for t, tile in enumerate(self.tiles):
             if not tile["is_train"]:
                 continue
-            s = self._block_slice(tile, f)
+            s = self._block_slice(t, f)
             if s is None:
                 continue
             dg, dh = self._grad_gh(tile["pred"], tile["labels"],
@@ -299,8 +303,8 @@ class BCDLearner(Learner):
         d_cap = np.zeros(nf_cap, dtype=np.float32)
         d_cap[:nf_blk] = d
         d_dev = jnp.asarray(d_cap)
-        for tile in self.tiles:  # train AND val (UpdtPred over all tiles)
-            s = self._block_slice(tile, f)
+        for t, tile in enumerate(self.tiles):  # train AND val (UpdtPred)
+            s = self._block_slice(t, f)
             if s is None:
                 continue
             tile["pred"] = self._pred_add(tile["pred"], s, d_dev)

@@ -100,6 +100,27 @@ class SGDLearner(Learner):
                                   fs=self.param.mesh_fs)
         self.store = SlotStore(uparam, mesh=self.mesh)
         self.do_embedding = self.V_dim > 0
+        # multi-controller: this host owns a contiguous slice of the global
+        # file parts (parallel/multihost.py; the reference's Rank()/
+        # NumWorkers() reader sharding)
+        from ..parallel.multihost import host_part
+        self._host_rank, self._num_hosts = host_part()
+        if self._num_hosts > 1:
+            if self.mesh is not None:
+                # a global mesh requires every host to issue the same
+                # sequence of collective-bearing steps; per-host readers
+                # produce differing batch counts/bucket shapes, which would
+                # deadlock SPMD. Synchronized-step multihost is future work.
+                raise ValueError(
+                    "mesh_dp/mesh_fs > 1 is not supported with multiple "
+                    "hosts yet; run single-host meshes, or multi-host "
+                    "without a mesh (independent per-host replicas)")
+            if not self.store.hashed:
+                log.warning(
+                    "multi-host run with the dictionary store: slot "
+                    "assignment is per-host; models are independent "
+                    "replicas. Set hash_capacity for a deterministic "
+                    "cross-host feature->slot mapping.")
         self._build_steps()
         return remain
 
@@ -183,11 +204,12 @@ class SGDLearner(Learner):
 
     # ----------------------------------------------------------- epochs
     def _model_name(self, prefix: str, it: int) -> str:
-        # single-controller: one shard, rank 0 (ModelName, sgd_learner.h:65-69)
+        # per-rank files like the reference's "<prefix>[_iter-k]_part-<rank>"
+        # (ModelName, sgd_learner.h:65-69) — no cross-host write races
         name = prefix
         if it >= 0:
             name += f"_iter-{it}"
-        return name + "_part-0"
+        return name + f"_part-{self._host_rank}"
 
     def _run_epoch(self, epoch: int, job_type: int, prog: Progress) -> None:
         p = self.param
@@ -212,16 +234,19 @@ class SGDLearner(Learner):
         p = self.param
         push_cnt = (job_type == K_TRAINING and epoch == 0
                     and self.do_embedding)
+        # this host's slice of the global part space
+        g_idx = self._host_rank * num_parts + part_idx
+        g_num = num_parts * self._num_hosts
         if job_type == K_TRAINING:
             # vary the shuffle/sampling stream across epochs and parts (the
             # reference's std::random_shuffle advances global state per epoch)
-            reader = BatchReader(p.data_in, p.data_format, part_idx,
-                                 num_parts, p.batch_size,
+            reader = BatchReader(p.data_in, p.data_format, g_idx,
+                                 g_num, p.batch_size,
                                  p.batch_size * p.shuffle, p.neg_sampling,
-                                 seed=epoch * max(num_parts, 1) + part_idx)
+                                 seed=epoch * max(g_num, 1) + g_idx)
         else:
-            reader = Reader(p.data_val or p.data_in, p.data_format, part_idx,
-                            num_parts, chunk_bytes=256 << 20)
+            reader = Reader(p.data_val or p.data_in, p.data_format, g_idx,
+                            g_num, chunk_bytes=256 << 20)
 
         def produce():
             # parsing + localization on the producer thread; store access
@@ -265,9 +290,10 @@ class SGDLearner(Learner):
                                 auc=float(auc)))
 
     def _save_pred(self, pred: np.ndarray, label) -> None:
-        """SavePred (sgd_learner.h:72-83)."""
+        """SavePred (sgd_learner.h:72-83); per-rank output file."""
         if self._fo_pred is None:
-            self._fo_pred = open(self.param.pred_out + "_part-0", "w")
+            self._fo_pred = open(
+                f"{self.param.pred_out}_part-{self._host_rank}", "w")
         out = 1.0 / (1.0 + np.exp(-pred)) if self.param.pred_prob else pred
         for i, v in enumerate(out):
             if label is not None:

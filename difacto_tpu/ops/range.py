@@ -1,0 +1,36 @@
+"""Range: the universal [begin, end) work/key partitioner.
+
+Equivalent of the reference's Range (src/common/range.h:11-60) — even
+segmentation drives file-part sharding, feature-block partition, and
+key-space slicing throughout the framework.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+
+class Range(NamedTuple):
+    begin: int
+    end: int
+
+    @property
+    def size(self) -> int:
+        return self.end - self.begin
+
+    def valid(self) -> bool:
+        return 0 <= self.begin <= self.end
+
+    def has(self, x: int) -> bool:
+        return self.begin <= x < self.end
+
+    def segment(self, idx: int, nparts: int) -> "Range":
+        """The idx-th of nparts even segments (Segment, range.h:46-52)."""
+        if not (0 <= idx < nparts):
+            raise ValueError(f"idx {idx} out of range of {nparts}")
+        span = self.size
+        return Range(self.begin + span * idx // nparts,
+                     self.begin + span * (idx + 1) // nparts)
+
+    def __mul__(self, k: int) -> "Range":
+        return Range(self.begin * k, self.end * k)

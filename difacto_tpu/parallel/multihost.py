@@ -1,0 +1,61 @@
+"""Multi-host (multi-controller) support over DCN.
+
+The reference scales out with dmlc-tracker launchers + ps-lite rendezvous
+(launch.py, SURVEY §2.10/§5.8). The TPU-native equivalent is JAX
+multi-controller SPMD: every host runs the same program,
+``jax.distributed.initialize`` performs the rendezvous (the Postoffice
+analog), ``jax.devices()`` then spans all hosts, and the existing mesh
+shardings (parallel/mesh.py) place collectives on ICI within a pod and DCN
+across pods — no learner code changes.
+
+Host-side data parallelism keeps the reference's contract: each host reads
+its own byte-range file parts (``host_part`` -> Reader(part_idx,
+num_parts)), the WorkloadPool semantics move one level up.
+
+For the model state to be identical across controllers the feature ->
+slot mapping must be deterministic without cross-host chatter — use the
+hashed store mode (store/local.py ``hash_capacity``), which maps ids to
+slots by modular hashing of the byte-reversed id (SURVEY §7 "fixed-capacity
+hashed embedding table").
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+log = logging.getLogger("difacto_tpu")
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """jax.distributed rendezvous; None args resolve from the standard env
+    (JAX's own vars, or DIFACTO_COORDINATOR / DIFACTO_NPROCS /
+    DIFACTO_RANK as set by launch.py)."""
+    import jax
+    coordinator_address = coordinator_address or os.environ.get(
+        "DIFACTO_COORDINATOR")
+    if num_processes is None and "DIFACTO_NPROCS" in os.environ:
+        num_processes = int(os.environ["DIFACTO_NPROCS"])
+    if process_id is None and "DIFACTO_RANK" in os.environ:
+        process_id = int(os.environ["DIFACTO_RANK"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    log.info("multi-host initialized: process %d of %d, %d global devices",
+             jax.process_index(), jax.process_count(),
+             len(jax.devices()))
+
+
+def host_part() -> Tuple[int, int]:
+    """(part_idx, num_parts) for this host's share of the input files —
+    the multi-controller analog of the reference's Rank()/NumWorkers()
+    reader sharding (src/lbfgs/lbfgs_learner.cc:148-150)."""
+    import jax
+    try:
+        return jax.process_index(), jax.process_count()
+    except RuntimeError:
+        return 0, 1

@@ -53,11 +53,15 @@ class SlotStore:
         self.mesh = mesh
         # feature dictionary as parallel sorted arrays (id -> slot); bulk
         # lookup/insert is vectorised via searchsorted + merge — the host-side
-        # analog of ps-lite's sorted-key requirement (kvstore_dist.h:95)
+        # analog of ps-lite's sorted-key requirement (kvstore_dist.h:95).
+        # hash_capacity > 0 replaces the dictionary with stateless modular
+        # hashing (deterministic across hosts; SURVEY §7 hashed table).
+        self.hashed = param.hash_capacity > 0
         self._keys = np.empty(0, dtype=FEAID_DTYPE)
         self._slots = np.empty(0, dtype=np.int64)
         self._next_slot = TRASH_SLOT + 1
-        self.state: SGDState = self._place(init_state(param, initial_capacity))
+        cap = param.hash_capacity if self.hashed else initial_capacity
+        self.state: SGDState = self._place(init_state(param, cap))
 
     def _place(self, state: SGDState) -> SGDState:
         if self.mesh is None:
@@ -76,6 +80,9 @@ class SlotStore:
         mapped to TRASH_SLOT when insert=False. New slots are assigned in the
         input's appearance order."""
         keys = np.asarray(keys, dtype=FEAID_DTYPE)
+        if self.hashed:
+            cap = np.uint64(self.param.hash_capacity - 1)
+            return (keys % cap + np.uint64(1)).astype(np.int32)
         n = len(self._keys)
         out = np.full(len(keys), TRASH_SLOT, dtype=np.int32)
         if n:
@@ -157,7 +164,22 @@ class SlotStore:
         return self._keys, self._slots
 
     def save(self, path: str, save_aux: bool = False) -> int:
-        """Checkpoint non-empty entries, sorted by key."""
+        """Checkpoint non-empty entries, sorted by key. Hashed mode has no
+        id dictionary — the full dense table is saved instead."""
+        if self.hashed:
+            st = {f: np.asarray(a) for f, a in zip(SGDState._fields,
+                                                   self.state)}
+            arrays = dict(hash_capacity=np.array(self.param.hash_capacity),
+                          V_dim=np.array(self.param.V_dim),
+                          save_aux=np.array(save_aux), **{
+                              k: st[k] for k in
+                              (("w", "cnt", "v_live", "V") + (
+                                  ("z", "sqrt_g", "Vg") if save_aux
+                                  else ()))})
+            tmp = path + ".tmp.npz"
+            np.savez_compressed(tmp, **arrays)
+            os.replace(tmp, path)
+            return int((st["w"] != 0).sum())
         keys, slots = self._sorted_items()
         st = {f: np.asarray(a) for f, a in zip(SGDState._fields, self.state)}
         keep = (st["w"][slots] != 0) | (st["cnt"][slots] != 0)
@@ -183,6 +205,25 @@ class SlotStore:
 
     def load(self, path: str) -> int:
         with np.load(path) as z:
+            if self.hashed != ("hash_capacity" in z.files):
+                raise ValueError(
+                    "checkpoint store mode mismatch: "
+                    f"checkpoint is {'hashed' if not self.hashed else 'a dictionary model'}, "
+                    f"store is {'hashed' if self.hashed else 'dictionary-based'}")
+            if "hash_capacity" in z.files:
+                if int(z["hash_capacity"]) != self.param.hash_capacity:
+                    raise ValueError("hashed checkpoint needs a store with "
+                                     "the same hash_capacity")
+                arr = {f: np.asarray(a) for f, a in
+                       zip(SGDState._fields,
+                           init_state(self.param,
+                                      self.param.hash_capacity))}
+                for k in ("w", "cnt", "v_live", "V", "z", "sqrt_g", "Vg"):
+                    if k in z.files:
+                        arr[k] = z[k]
+                self.state = self._place(SGDState(
+                    **{f: jnp.asarray(a) for f, a in arr.items()}))
+                return int((np.asarray(arr["w"]) != 0).sum())
             ck_vdim = int(z["V_dim"]) if "V_dim" in z.files else 0
             if ck_vdim != self.param.V_dim:
                 raise ValueError(
@@ -217,8 +258,20 @@ class SlotStore:
              need_reverse: bool = True) -> int:
         """Human-readable TSV export (Updater::Dump, sgd_updater.h:108-139):
         ``feaid size w [sqrt_g z] V... [Vg...]`` per line, skipping empty
-        entries. need_reverse un-reverses ids back to the original space."""
-        keys, slots = self._sorted_items()
+        entries. need_reverse un-reverses ids back to the original space.
+        Hashed mode has no id dictionary: the first column is the slot id
+        and need_reverse is ignored."""
+        if self.hashed:
+            w = np.asarray(self.state.w)
+            keep = w != 0
+            if self.param.V_dim > 0:  # keep l1-shrunk rows with live V
+                keep |= np.asarray(self.state.v_live)
+            keep[TRASH_SLOT] = False
+            slots = np.nonzero(keep)[0]
+            keys = slots.astype(FEAID_DTYPE)
+            need_reverse = False
+        else:
+            keys, slots = self._sorted_items()
         st = {f: np.asarray(a) for f, a in zip(SGDState._fields, self.state)}
         n = 0
         with open(path, "w") as f:

@@ -1,0 +1,47 @@
+"""Vector clock over per-node counters — the bounded-delay (SSP/BSP) gadget.
+
+Equivalent of the reference's VectorClock (src/store/vector_clock.h:9-58),
+which was reserved for the *unimplemented* sync modes of KVStoreDist
+(sync_mode/max_delay, LOG(FATAL) "SSP BSP TODO",
+src/store/kvstore_dist.h:137-150). Here it is functional and usable by a
+multi-host pipeline to bound staleness: each host ticks its clock per
+completed step; a host may proceed while ``min() >= my_clock - max_delay``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class VectorClock:
+    def __init__(self, num_nodes: int):
+        if num_nodes <= 0:
+            raise ValueError("num_nodes must be positive")
+        self._clock: List[int] = [0] * num_nodes
+
+    def update(self, node: int, t: int = -1) -> bool:
+        """Advance node's clock (to t, or +1); returns True when the global
+        min advanced — the reference's signal that a blocked pull may
+        proceed (vector_clock.h:24-43)."""
+        old_min = self.min()
+        if t < 0:
+            self._clock[node] += 1
+        else:
+            if t < self._clock[node]:
+                raise ValueError("clock must be monotone")
+            self._clock[node] = t
+        return self.min() > old_min
+
+    def min(self) -> int:
+        return min(self._clock)
+
+    def max(self) -> int:
+        return max(self._clock)
+
+    def get(self, node: int) -> int:
+        return self._clock[node]
+
+    def may_proceed(self, node: int, max_delay: int) -> bool:
+        """Bounded-staleness check: node may start step clock[node]+1 iff
+        the slowest node is within max_delay steps (SSP; 0 = BSP)."""
+        return self._clock[node] - self.min() <= max_delay

@@ -59,6 +59,11 @@ class SGDUpdaterParam(Param):
     V_threshold: int = 10
     l1_shrk: bool = True
     seed: int = 0
+    # > 0 switches the store to a fixed-capacity hashed table: slot =
+    # reversed_id mod (capacity-1) + 1, no host dictionary. Deterministic
+    # across hosts (multi-controller requirement, parallel/multihost.py);
+    # collisions alias features, the standard hashing-trick tradeoff.
+    hash_capacity: int = 0
 
 
 class SGDState(NamedTuple):

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Multi-process launcher — the dmlc-tracker equivalent.
+
+The reference submits scheduler/server/worker processes via dmlc-tracker
+(launch.py:32-78, run_local/ssh/yarn.sh). The TPU framework is
+multi-controller SPMD: every process runs the SAME program; this launcher
+starts ``-n`` local processes with the rendezvous env
+(DIFACTO_COORDINATOR/NPROCS/RANK -> jax.distributed.initialize, see
+difacto_tpu/parallel/multihost.py). On a real TPU pod each host's runtime
+(GKE/xpk/ray) sets the equivalent variables instead.
+
+Usage:
+    python launch.py -n 2 -- python -m difacto_tpu train.conf k=v ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", "--num-processes", type=int, default=1)
+    ap.add_argument("--port", type=int, default=7799)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="command to launch (prefix with --)")
+    args = ap.parse_args()
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        ap.error("no command given")
+
+    procs = []
+    for rank in range(args.num_processes):
+        env = dict(os.environ)
+        env.update(
+            DIFACTO_COORDINATOR=f"127.0.0.1:{args.port}",
+            DIFACTO_NPROCS=str(args.num_processes),
+            DIFACTO_RANK=str(rank),
+        )
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

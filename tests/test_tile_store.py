@@ -1,0 +1,75 @@
+"""DataStore / TileCache tests (src/data/data_store.h, tile_store.h analogs)."""
+
+import numpy as np
+import pytest
+
+from difacto_tpu.data.tile_store import DataStore, TileCache
+
+
+def test_datastore_store_fetch_range():
+    ds = DataStore()
+    ds.store("x", np.arange(10, dtype=np.int32))
+    np.testing.assert_array_equal(ds.fetch("x"), np.arange(10))
+    np.testing.assert_array_equal(ds.fetch("x", 1, 3), [1, 2])  # the
+    # reference's doc example (data_store.h:66-74)
+    assert ds.size("x") == 10
+    ds.remove("x")
+    with pytest.raises(KeyError):
+        ds.fetch("x")
+
+
+def test_datastore_spill_roundtrip(tmp_path):
+    ds = DataStore(max_mem_bytes=100, spill_dir=str(tmp_path))
+    a = np.arange(20, dtype=np.float32)  # 80 bytes
+    b = np.arange(10, dtype=np.float32)  # 40 bytes -> a spills
+    ds.store("a", a)
+    ds.store("b", b)
+    assert ds._spilled  # something went to disk
+    np.testing.assert_array_equal(ds.fetch("a"), a)  # reload transparent
+    np.testing.assert_array_equal(ds.fetch("b"), b)
+
+
+def test_datastore_requires_spill_dir():
+    with pytest.raises(ValueError):
+        DataStore(max_mem_bytes=10)
+
+
+def test_tile_cache_lru():
+    built = []
+
+    def build(r, c):
+        built.append((r, c))
+        return (r, c)
+
+    tc = TileCache(build, max_items=2)
+    assert tc.fetch(0, 0) == (0, 0)
+    assert tc.fetch(0, 1) == (0, 1)
+    assert tc.fetch(0, 0) == (0, 0)  # hit
+    assert tc.hits == 1
+    tc.fetch(0, 2)                   # evicts (0, 1)
+    tc.fetch(0, 1)                   # rebuild
+    assert built.count((0, 1)) == 2
+    assert len(tc) == 2
+
+
+def test_bcd_with_bounded_tile_cache(rcv1_path):
+    """BCD converges identically with an LRU-bounded tile cache."""
+    from difacto_tpu.learners import Learner
+
+    def run(cache_items):
+        learner = Learner.create("bcd")
+        learner.init([("data_in", rcv1_path), ("l1", ".1"), ("lr", ".05"),
+                      ("block_ratio", "1"), ("tail_feature_filter", "0"),
+                      ("max_num_epochs", "3"), ("random_block", "0"),
+                      ("tile_cache_items", str(cache_items))])
+        seen = []
+        learner.add_epoch_end_callback(lambda e, p: seen.append(p.objv))
+        learner.run()
+        return seen, learner
+
+    ref, unlimited = run(0)
+    bounded, learner = run(1)  # forces rebuilds across blocks
+    np.testing.assert_allclose(bounded, ref, rtol=1e-6)
+    # the bounded cache must rebuild evicted tiles; unlimited builds once
+    assert learner._tile_cache.misses > unlimited._tile_cache.misses
+    assert len(learner._tile_cache) == 1
