@@ -51,3 +51,40 @@ def parse_libsvm_native(chunk: bytes) -> RowBlock:
         index=index[:nnz].copy(),
         value=value[:nnz].copy() if out_has_value.value else None,
     )
+
+
+def parse_criteo_native(chunk: bytes, is_train: bool = True) -> RowBlock:
+    lib = get_lib()
+    if lib is None:
+        from .parsers import parse_criteo
+        return parse_criteo(chunk, is_train)
+
+    max_rows = chunk.count(b"\n") + 2
+    # every feature field follows a tab in train mode; without a label the
+    # first field has no leading tab, so budget one extra feature per row
+    max_nnz = chunk.count(b"\t") + (1 if is_train else max_rows) + 1
+    labels = np.empty(max_rows, dtype=REAL_DTYPE)
+    offset = np.empty(max_rows + 1, dtype=np.int64)
+    index = np.empty(max_nnz, dtype=FEAID_DTYPE)
+    out_rows = ctypes.c_int64()
+    out_nnz = ctypes.c_int64()
+
+    rc = lib.difacto_parse_criteo(
+        chunk, len(chunk), int(is_train),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        offset.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        index.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        max_rows, max_nnz,
+        ctypes.byref(out_rows), ctypes.byref(out_nnz))
+    if rc != 0:
+        raise ValueError("malformed criteo chunk" if rc == -1
+                         else "criteo parse buffer overflow")
+    n, nnz = out_rows.value, out_nnz.value
+    if n == 0:
+        return empty_block()
+    return RowBlock(
+        offset=offset[:n + 1].copy(),
+        label=labels[:n].copy(),
+        index=index[:nnz].copy(),
+        value=None,  # binary features
+    )

@@ -10,7 +10,6 @@ small runs only.
 
 from __future__ import annotations
 
-import hashlib
 from typing import Optional
 
 import numpy as np
@@ -50,14 +49,36 @@ def parse_libsvm(chunk: bytes) -> RowBlock:
     return RowBlock(offset=offset, label=label, index=index, value=value)
 
 
-def _hash64(data: bytes) -> int:
-    """Stable 64-bit string hash.
+_M64 = 0xC6A4A7935BD1E995
+_MASK = (1 << 64) - 1
 
-    The reference uses CityHash64 (criteo_parser.h:96-103); we use blake2b-8
-    — any stable uniform 64-bit hash preserves the semantics (hashed feature
-    space with per-column group ids in the low 12 bits).
+
+def _hash64(data: bytes, seed: int = 0) -> int:
+    """MurmurHash64A (pure-Python reference implementation).
+
+    The reference uses CityHash64 (criteo_parser.h:96-103); we use
+    MurmurHash64A — any stable uniform 64-bit hash preserves the semantics
+    (hashed feature space with per-column group ids in the low 12 bits).
+    This function and the native one (native/criteo_parser.cc) MUST agree
+    bit for bit; tests/test_native.py checks it.
     """
-    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "little")
+    n = len(data)
+    h = (seed ^ (n * _M64)) & _MASK
+    nblocks = n // 8
+    for i in range(nblocks):
+        k = int.from_bytes(data[i * 8:i * 8 + 8], "little")
+        k = (k * _M64) & _MASK
+        k ^= k >> 47
+        k = (k * _M64) & _MASK
+        h = ((h ^ k) * _M64) & _MASK
+    tail = data[nblocks * 8:]
+    if tail:
+        h ^= int.from_bytes(tail, "little")
+        h = (h * _M64) & _MASK
+    h ^= h >> 47
+    h = (h * _M64) & _MASK
+    h ^= h >> 47
+    return h
 
 
 def parse_criteo(chunk: bytes, is_train: bool = True) -> RowBlock:
@@ -149,9 +170,11 @@ def get_parser(fmt: str):
         from .native_parsers import parse_libsvm_native
         return parse_libsvm_native
     if fmt == "criteo":
-        return parse_criteo
+        from .native_parsers import parse_criteo_native
+        return parse_criteo_native
     if fmt == "criteo_test":
-        return lambda chunk: parse_criteo(chunk, is_train=False)
+        from .native_parsers import parse_criteo_native
+        return lambda chunk: parse_criteo_native(chunk, is_train=False)
     if fmt == "adfea":
         return parse_adfea
     raise ValueError(f"unknown data format: {fmt}")

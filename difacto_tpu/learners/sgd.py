@@ -125,12 +125,35 @@ class SGDLearner(Learner):
         return remain
 
     def _build_steps(self) -> None:
+        from ..ops.batch import unpack_batch
         from ..step import make_step_fns
         fns = self.store.fns
         _, train_step, eval_step = make_step_fns(fns, self.loss)
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
         self._apply_count = jax.jit(fns.apply_count, donate_argnums=0)
+
+        # packed single-transfer variants (ops/batch.py pack_batch): the
+        # whole batch rides in one i32 + one f32 buffer — on tunneled or
+        # remote devices per-transfer latency dominates the host->device
+        # path, so 2 transfers/batch instead of 8
+        def packed_train(state, i32, f32, b_cap, nnz_cap, u_cap, has_cnt,
+                         binary):
+            batch, slots, counts = unpack_batch(i32, f32, b_cap, nnz_cap,
+                                                u_cap, has_cnt, binary)
+            if counts is not None:
+                state = fns.apply_count(state, slots, counts)
+            return train_step(state, batch, slots)
+
+        def packed_eval(state, i32, f32, b_cap, nnz_cap, u_cap, binary):
+            batch, slots, _ = unpack_batch(i32, f32, b_cap, nnz_cap, u_cap,
+                                           binary=binary)
+            return eval_step(state, batch, slots)
+
+        self._packed_train = jax.jit(packed_train, donate_argnums=0,
+                                     static_argnums=(3, 4, 5, 6, 7))
+        self._packed_eval = jax.jit(packed_eval,
+                                    static_argnums=(3, 4, 5, 6))
 
     # ----------------------------------------------------------- driver
     def run(self) -> None:
@@ -255,39 +278,57 @@ class SGDLearner(Learner):
                 yield blk, compact(blk, need_counts=push_cnt)
 
         from ..data.prefetch import prefetch
+        from ..ops.batch import pack_batch
         pending: list = []  # device scalars fetched lazily at the end
         for blk, (cblk, uniq, cnts) in prefetch(produce(), depth=2):
             u_cap = bucket(len(uniq))
+            b_cap, nnz_cap = bucket(blk.size), bucket(blk.nnz)
             slots_np = self.store.map_keys(uniq)
-            slots = self.store.pad_slots(slots_np, u_cap)
-            dev = pad_batch(cblk, num_uniq=len(uniq),
-                            batch_cap=bucket(blk.size),
-                            nnz_cap=bucket(blk.nnz))
-            if self.mesh is not None:
+            if self.mesh is None:
+                # packed path: 2 host->device transfers per batch
+                i32, f32, binary = pack_batch(
+                    cblk, len(uniq), slots_np, b_cap, nnz_cap, u_cap,
+                    counts=cnts if push_cnt else None)
+                i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
+                if job_type == K_TRAINING:
+                    self.store.state, objv, auc = self._packed_train(
+                        self.store.state, i32, f32, b_cap, nnz_cap, u_cap,
+                        push_cnt, binary)
+                else:
+                    pred, objv, auc = self._packed_eval(
+                        self.store.state, i32, f32, b_cap, nnz_cap, u_cap,
+                        binary)
+            else:
+                slots = self.store.pad_slots(slots_np, u_cap)
+                dev = pad_batch(cblk, num_uniq=len(uniq),
+                                batch_cap=b_cap, nnz_cap=nnz_cap)
                 from ..parallel import batch_sharding, shard_pytree
                 dev = shard_pytree(dev, batch_sharding(self.mesh))
-            if push_cnt:
-                c = np.zeros(u_cap, dtype=np.float32)
-                c[:len(cnts)] = cnts
-                self.store.state = self._apply_count(
-                    self.store.state, slots, jnp.asarray(c))
-            if job_type == K_TRAINING:
-                self.store.state, objv, auc = self._train_step(
-                    self.store.state, dev, slots)
-            else:
-                pred, objv, auc = self._eval_step(self.store.state, dev,
-                                                  slots)
-                if job_type == K_PREDICTION and p.pred_out:
-                    # stream predictions per batch (SavePred,
-                    # sgd_learner.cc:231-238) — don't buffer the dataset
-                    self._save_pred(np.asarray(pred)[:blk.size], blk.label)
+                if push_cnt:
+                    c = np.zeros(u_cap, dtype=np.float32)
+                    c[:len(cnts)] = cnts
+                    self.store.state = self._apply_count(
+                        self.store.state, slots, jnp.asarray(c))
+                if job_type == K_TRAINING:
+                    self.store.state, objv, auc = self._train_step(
+                        self.store.state, dev, slots)
+                else:
+                    pred, objv, auc = self._eval_step(self.store.state, dev,
+                                                      slots)
+            if job_type == K_PREDICTION and p.pred_out:
+                # stream predictions per batch (SavePred,
+                # sgd_learner.cc:231-238) — don't buffer the dataset
+                self._save_pred(np.asarray(pred)[:blk.size], blk.label)
             pending.append((blk.size, objv, auc))
 
-        # metric scalars are fetched only here, after all batches are
+        # metric scalars are fetched in ONE transfer after all batches are
         # dispatched — JAX async dispatch supplies the pipeline overlap
-        for nrows, objv, auc in pending:
-            prog.merge(Progress(nrows=nrows, loss=float(objv),
-                                auc=float(auc)))
+        if pending:
+            flat = jnp.stack([s for _, o, a in pending for s in (o, a)])
+            vals = np.asarray(flat)
+            for i, (nrows, _, _) in enumerate(pending):
+                prog.merge(Progress(nrows=nrows, loss=float(vals[2 * i]),
+                                    auc=float(vals[2 * i + 1])))
 
     def _save_pred(self, pred: np.ndarray, label) -> None:
         """SavePred (sgd_learner.h:72-83); per-rank output file."""

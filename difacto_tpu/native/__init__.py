@@ -20,7 +20,8 @@ log = logging.getLogger("difacto_tpu")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "_difacto_native.so")
-_SRC = [os.path.join(_DIR, "libsvm_parser.cc")]
+_SRC = [os.path.join(_DIR, "libsvm_parser.cc"),
+        os.path.join(_DIR, "criteo_parser.cc")]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -76,5 +77,16 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int),
         ]
+        lib.difacto_parse_criteo.restype = ctypes.c_int
+        lib.difacto_parse_criteo.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.difacto_murmur64a.restype = ctypes.c_uint64
+        lib.difacto_murmur64a.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint64]
         _lib = lib
         return _lib

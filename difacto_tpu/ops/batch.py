@@ -57,6 +57,89 @@ def bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+def pack_batch(blk: RowBlock, num_uniq: int, slots: np.ndarray,
+               batch_cap: int, nnz_cap: int, u_cap: int,
+               counts: Optional[np.ndarray] = None):
+    """Pack a localized block + slot vector into TWO host buffers
+    (int32 + float32) so staging costs two device transfers instead of
+    eight — on tunneled/remote devices per-transfer latency dominates.
+
+    Layout (static per bucket): i32 = [rows(nnz) | cols(nnz) | slots(u)];
+    f32 = [vals(nnz)? | labels(B) | rweight(B) | row_mask(B) | counts(u)?].
+    Binary blocks (value is None — e.g. criteo) omit the vals section and
+    reconstruct ones*row-validity on device, halving the f32 payload.
+    ``unpack_batch`` is the jit-side inverse.
+    """
+    b, nnz = blk.size, blk.nnz
+    if b > batch_cap or nnz > nnz_cap or len(slots) > u_cap:
+        raise ValueError("batch exceeds caps")
+    binary = blk.value is None
+    # trailing 3 ints: [b, num_uniq, nnz] — kept in the i32 buffer so they
+    # stay exact (f32 would round past 2^24)
+    i32 = np.zeros(2 * nnz_cap + u_cap + 3, dtype=np.int32)
+    i32[:nnz] = blk.row_ids()
+    i32[nnz:nnz_cap] = max(b - 1, 0)  # pad rows -> a real segment, vals 0
+    i32[nnz_cap:nnz_cap + nnz] = blk.index.astype(np.int32)
+    i32[2 * nnz_cap:2 * nnz_cap + len(slots)] = slots
+    # slot padding stays 0 == trash slot
+    i32[2 * nnz_cap + u_cap:] = (b, num_uniq, nnz)
+
+    vals_n = 0 if binary else nnz_cap
+    nf32 = vals_n + 3 * batch_cap \
+        + (u_cap if counts is not None else 0)
+    f32 = np.zeros(max(nf32, 1), dtype=REAL_DTYPE)
+    o = 0
+    if not binary:
+        f32[:nnz] = blk.value
+        o = nnz_cap
+    f32[o:o + b] = blk.label
+    o += batch_cap
+    f32[o:o + b] = blk.weight if blk.weight is not None else 1.0
+    o += batch_cap
+    f32[o:o + b] = 1.0
+    o += batch_cap
+    if counts is not None:
+        f32[o:o + len(counts)] = counts
+    return i32, f32, binary
+
+
+def unpack_batch(i32, f32, batch_cap: int, nnz_cap: int, u_cap: int,
+                 has_counts: bool = False, binary: bool = False):
+    """jit-traceable inverse of pack_batch ->
+    (DeviceBatch, slots, counts-or-None)."""
+    import jax.numpy as jnp
+
+    rows = i32[:nnz_cap]
+    cols = i32[nnz_cap:2 * nnz_cap]
+    slots = i32[2 * nnz_cap:2 * nnz_cap + u_cap]
+    meta = i32[2 * nnz_cap + u_cap:]  # [b, num_uniq, nnz], exact int32
+    if binary:
+        # all-ones values, zeroed on padding entries (value elision,
+        # src/reader/batch_reader.cc:71-73 carried to the device side)
+        iota = jnp.arange(nnz_cap, dtype=jnp.int32)
+        vals = (iota < meta[2]).astype(jnp.float32)
+        o = 0
+    else:
+        vals = f32[:nnz_cap]
+        o = nnz_cap
+    labels = f32[o:o + batch_cap]
+    o += batch_cap
+    rweight = f32[o:o + batch_cap]
+    o += batch_cap
+    row_mask = f32[o:o + batch_cap]
+    o += batch_cap
+    counts = None
+    if has_counts:
+        counts = f32[o:o + u_cap]
+    batch = DeviceBatch(
+        rows=rows, cols=cols, vals=vals, labels=labels, rweight=rweight,
+        row_mask=row_mask,
+        num_rows=meta[0],
+        num_uniq=meta[1],
+    )
+    return batch, slots, counts
+
+
 def pad_batch(blk: RowBlock, num_uniq: int,
               batch_cap: Optional[int] = None,
               nnz_cap: Optional[int] = None) -> DeviceBatch:

@@ -93,3 +93,68 @@ def test_reader_uses_native(rcv1_path):
     from difacto_tpu.data import Reader
     blocks = list(Reader(rcv1_path, "libsvm"))
     assert sum(b.size for b in blocks) == 100
+
+
+@needs_native
+def test_murmur64a_native_matches_python():
+    """The C++ and pure-Python MurmurHash64A must agree bit for bit —
+    hosts with and without the toolchain must build the same feature
+    space (parsers.py _hash64 docstring contract)."""
+    import ctypes
+    from difacto_tpu.data.parsers import _hash64
+    lib = get_lib()
+    for s in [b"", b"a", b"ab", b"criteo", b"x" * 7, b"y" * 8, b"z" * 9,
+              b"longer_categorical_value" * 3, bytes(range(256))]:
+        assert _hash64(s) == lib.difacto_murmur64a(s, len(s), 0), s
+
+
+def _criteo_chunk(nrows, with_empties=True, seed=0):
+    rng = np.random.RandomState(seed)
+    lines = []
+    for _ in range(nrows):
+        ints = [str(rng.randint(0, 1000))
+                if (not with_empties or rng.rand() > 0.2) else ""
+                for _ in range(13)]
+        cats = [f"c{rng.randint(0, 9999):x}"
+                if (not with_empties or rng.rand() > 0.1) else ""
+                for _ in range(26)]
+        lines.append(f"{rng.randint(0, 2)}\t" + "\t".join(ints + cats))
+    return ("\n".join(lines) + "\n").encode()
+
+
+@needs_native
+def test_criteo_native_matches_python():
+    from difacto_tpu.data.parsers import parse_criteo
+    from difacto_tpu.data.native_parsers import parse_criteo_native
+    chunk = _criteo_chunk(300)
+    a = parse_criteo(chunk)
+    b = parse_criteo_native(chunk)
+    assert a.size == b.size == 300
+    np.testing.assert_array_equal(a.offset, b.offset)
+    np.testing.assert_array_equal(a.label, b.label)
+    np.testing.assert_array_equal(a.index, b.index)
+
+
+@needs_native
+def test_criteo_native_test_mode_and_crlf():
+    """is_train=False (label-less rows; regression: buffer sizing) and
+    CRLF blank lines (regression: phantom rows) match the Python parser."""
+    from difacto_tpu.data.parsers import parse_criteo
+    from difacto_tpu.data.native_parsers import parse_criteo_native
+    # fully-populated label-less rows — the worst case for nnz sizing
+    rng = np.random.RandomState(1)
+    lines = ["\t".join(str(rng.randint(0, 99)) for _ in range(39))
+             for _ in range(8)]
+    chunk = ("\n".join(lines) + "\n").encode()
+    a = parse_criteo(chunk, is_train=False)
+    b = parse_criteo_native(chunk, is_train=False)
+    assert a.size == b.size == 8
+    np.testing.assert_array_equal(a.index, b.index)
+    assert (b.label == 0).all()
+
+    crlf = b"1\ta\tb\r\n\r\n0\tc\r\n"
+    a = parse_criteo(crlf)
+    b = parse_criteo_native(crlf)
+    assert a.size == b.size == 2  # the blank CRLF line is not a row
+    np.testing.assert_array_equal(a.label, b.label)
+    np.testing.assert_array_equal(a.index, b.index)
