@@ -163,12 +163,26 @@ class SlotStore:
     def _sorted_items(self) -> Tuple[np.ndarray, np.ndarray]:
         return self._keys, self._slots
 
+    @staticmethod
+    def _state_np(state: SGDState) -> dict:
+        """Host view with the logical V/Vg split (state stores fused VVg)."""
+        d = {f: np.asarray(a) for f, a in zip(SGDState._fields, state)}
+        vv = d.pop("VVg")
+        k = vv.shape[1] // 2
+        d["V"], d["Vg"] = vv[:, :k], vv[:, k:]
+        return d
+
+    def _assemble_state(self, arr: dict) -> SGDState:
+        """Inverse of _state_np: dict with V/Vg -> SGDState with VVg."""
+        vvg = np.concatenate([arr.pop("V"), arr.pop("Vg")], axis=1)
+        return SGDState(VVg=jnp.asarray(vvg),
+                        **{f: jnp.asarray(a) for f, a in arr.items()})
+
     def save(self, path: str, save_aux: bool = False) -> int:
         """Checkpoint non-empty entries, sorted by key. Hashed mode has no
         id dictionary — the full dense table is saved instead."""
         if self.hashed:
-            st = {f: np.asarray(a) for f, a in zip(SGDState._fields,
-                                                   self.state)}
+            st = self._state_np(self.state)
             arrays = dict(hash_capacity=np.array(self.param.hash_capacity),
                           V_dim=np.array(self.param.V_dim),
                           save_aux=np.array(save_aux), **{
@@ -181,7 +195,7 @@ class SlotStore:
             os.replace(tmp, path)
             return int((st["w"] != 0).sum())
         keys, slots = self._sorted_items()
-        st = {f: np.asarray(a) for f, a in zip(SGDState._fields, self.state)}
+        st = self._state_np(self.state)
         keep = (st["w"][slots] != 0) | (st["cnt"][slots] != 0)
         if self.param.V_dim > 0:
             keep |= st["v_live"][slots]
@@ -214,16 +228,19 @@ class SlotStore:
                 if int(z["hash_capacity"]) != self.param.hash_capacity:
                     raise ValueError("hashed checkpoint needs a store with "
                                      "the same hash_capacity")
-                arr = {f: np.asarray(a) for f, a in
-                       zip(SGDState._fields,
-                           init_state(self.param,
-                                      self.param.hash_capacity))}
+                ck_vdim = int(z["V_dim"]) if "V_dim" in z.files else 0
+                if ck_vdim != self.param.V_dim:
+                    raise ValueError(
+                        f"checkpoint V_dim={ck_vdim} != configured "
+                        f"V_dim={self.param.V_dim} ({path})")
+                arr = self._state_np(init_state(self.param,
+                                                self.param.hash_capacity))
                 for k in ("w", "cnt", "v_live", "V", "z", "sqrt_g", "Vg"):
                     if k in z.files:
                         arr[k] = z[k]
-                self.state = self._place(SGDState(
-                    **{f: jnp.asarray(a) for f, a in arr.items()}))
-                return int((np.asarray(arr["w"]) != 0).sum())
+                nnz = int((np.asarray(arr["w"]) != 0).sum())
+                self.state = self._place(self._assemble_state(arr))
+                return nnz
             ck_vdim = int(z["V_dim"]) if "V_dim" in z.files else 0
             if ck_vdim != self.param.V_dim:
                 raise ValueError(
@@ -238,7 +255,7 @@ class SlotStore:
             while cap < n + 1:
                 cap *= 2
             st = init_state(self.param, cap)
-            arr = {f: np.asarray(a).copy() for f, a in zip(SGDState._fields, st)}
+            arr = {f: a.copy() for f, a in self._state_np(st).items()}
             sl = np.arange(1, n + 1)
             arr["w"][sl] = z["w"]
             arr["cnt"][sl] = z["cnt"]
@@ -250,8 +267,7 @@ class SlotStore:
                 arr["sqrt_g"][sl] = z["sqrt_g"]
                 if z["Vg"].size:
                     arr["Vg"][sl] = z["Vg"]
-            self.state = self._place(SGDState(
-                **{f: jnp.asarray(a) for f, a in arr.items()}))
+            self.state = self._place(self._assemble_state(arr))
         return n
 
     def dump(self, path: str, dump_aux: bool = False,
@@ -272,7 +288,7 @@ class SlotStore:
             need_reverse = False
         else:
             keys, slots = self._sorted_items()
-        st = {f: np.asarray(a) for f, a in zip(SGDState._fields, self.state)}
+        st = self._state_np(self.state)
         n = 0
         with open(path, "w") as f:
             for k, s in zip(keys, slots):

@@ -67,18 +67,32 @@ class SGDUpdaterParam(Param):
 
 
 class SGDState(NamedTuple):
-    """Slot-table model state; all arrays have capacity+1 rows (row 0 trash)."""
+    """Slot-table model state; all arrays have capacity+1 rows (row 0 trash).
+
+    The embedding values and their AdaGrad accumulators live in ONE array
+    ``VVg`` (f32[C, 2k]: V in [:, :k], Vg in [:, k:]) so the per-step
+    gather/scatter touches a single wide row per feature — TPU scatter cost
+    scales with the number of scattered rows, so one 2k-wide scatter beats
+    two k-wide ones (measured ~22 ms vs ~44 ms for 131k rows, k=64).
+    """
     w: jnp.ndarray        # f32[C]
     z: jnp.ndarray        # f32[C] FTRL dual
     sqrt_g: jnp.ndarray   # f32[C] FTRL accumulated grad norm
     cnt: jnp.ndarray      # f32[C] feature occurrence counts
-    V: jnp.ndarray        # f32[C, k] embeddings (k may be 0)
-    Vg: jnp.ndarray       # f32[C, k] AdaGrad accumulators
+    VVg: jnp.ndarray      # f32[C, 2k] embeddings + AdaGrad accumulators
     v_live: jnp.ndarray   # bool[C] embedding activated
 
     @property
     def capacity(self) -> int:
         return self.w.shape[0]
+
+    @property
+    def V(self) -> jnp.ndarray:
+        return self.VVg[:, :self.VVg.shape[1] // 2]
+
+    @property
+    def Vg(self) -> jnp.ndarray:
+        return self.VVg[:, self.VVg.shape[1] // 2:]
 
 
 def init_state(param: SGDUpdaterParam, capacity: int) -> SGDState:
@@ -91,7 +105,8 @@ def init_state(param: SGDUpdaterParam, capacity: int) -> SGDState:
         return jnp.zeros(capacity, dtype=jnp.float32)
     return SGDState(
         w=zeros(), z=zeros(), sqrt_g=zeros(), cnt=zeros(),
-        V=V, Vg=jnp.zeros((capacity, k), dtype=jnp.float32),
+        VVg=jnp.concatenate(
+            [V, jnp.zeros((capacity, k), dtype=jnp.float32)], axis=1),
         v_live=jnp.zeros(capacity, dtype=bool),
     )
 
@@ -134,7 +149,11 @@ def make_fns(param: SGDUpdaterParam):
         vmask = state.v_live[slots]
         if param.l1_shrk:
             vmask = vmask & (w != 0)
-        return w, state.V[slots], vmask.astype(jnp.float32)
+        # gather FULL [V|Vg] rows then slice: a partial-row gather
+        # (VVg[slots, :k]) lowers to a strided gather that is ~8x slower;
+        # the full-row gather is CSE'd with apply_grad's in the fused step
+        V = state.VVg[slots][:, :param.V_dim]
+        return w, V, vmask.astype(jnp.float32)
 
     def apply_count(state: SGDState, slots: jnp.ndarray, counts: jnp.ndarray
                     ) -> SGDState:
@@ -168,16 +187,17 @@ def make_fns(param: SGDUpdaterParam):
         )
 
         if has_V and gV is not None:
-            V = state.V[slots]
-            Vg = state.Vg[slots]
+            # ONE gather + ONE scatter over the fused [V | Vg] rows
+            VVg = state.VVg[slots]
+            V, Vg = VVg[:, :param.V_dim], VVg[:, param.V_dim:]
             gv = gV + V_l2 * V
             Vg_new = jnp.sqrt(Vg * Vg + gv * gv)
             V_new = V - V_lr / (Vg_new + V_lr_beta) * gv
             upd = pull_vmask[:, None] > 0
+            new_rows = jnp.where(
+                upd, jnp.concatenate([V_new, Vg_new], axis=1), VVg)
             state = state._replace(
-                V=state.V.at[slots].set(jnp.where(upd, V_new, V)),
-                Vg=state.Vg.at[slots].set(jnp.where(upd, Vg_new, Vg)),
-            )
+                VVg=state.VVg.at[slots].set(new_rows))
 
         return state._replace(v_live=_refresh_v_live(param, state))
 
