@@ -150,7 +150,7 @@ def main() -> None:
 
     def scan_body(state, i):
         batch = jax.tree_util.tree_map(lambda x: x[i % n_bk], stacked)
-        state, objv, auc = step.__wrapped__(state, batch, slots[i % n_bk])
+        state, objv, auc = step(state, batch, slots[i % n_bk])
         return state, objv
 
     @jax.jit
