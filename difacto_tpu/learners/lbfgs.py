@@ -195,17 +195,25 @@ class LBFGSLearner(Learner):
             is_w[offsets[:-1]] = True
             w[:self.N] = np.where(is_w, 0.0, vals)
 
-        # regularizer coefficient per coordinate: l2 on w, V_l2 on V
-        c = np.zeros(self.N_pad, dtype=np.float32)
-        c[:self.N] = up.V_l2
-        c[offsets[:-1]] = up.l2
-        self.reg_c = jnp.asarray(c)
+        self._refresh_layout_constants()
         self.weights = jnp.asarray(w)
 
         self.train_tiles = [self._build_tile(cb, u)
                             for cb, u in self._raw_train]
         self.val_tiles = [self._build_tile(cb, u) for cb, u in self._raw_val]
         del self._raw_train, self._raw_val
+
+    def _refresh_layout_constants(self) -> None:
+        """(Re)derive the device constants tied to the flat layout: the
+        per-coordinate regularizer (l2 on w positions, V_l2 on V) and the
+        real-parameter count. Every path that changes N/N_pad/offsets must
+        call this — these ride as runtime jit arguments precisely so a
+        layout change can never leave stale trace-time copies behind."""
+        c = np.zeros(self.N_pad, dtype=np.float32)
+        c[:self.N] = self.uparam.V_l2
+        c[self.offsets[:-1]] = self.uparam.l2
+        self.reg_c = jnp.asarray(c)
+        self._n_real = jnp.asarray(self.N, dtype=jnp.int32)
 
     def _warm_start(self, path: str) -> int:
         """Copy checkpoint weights into the current layout (model_in warm
@@ -294,19 +302,21 @@ class LBFGSLearner(Learner):
             return auc_times_n_jnp(tile.batch.labels, pred,
                                    tile.batch.row_mask)
 
-        def finish_grad(grad):
+        def finish_grad(grad, n):
             """gamma transform (CalcGrad, lbfgs_learner.cc:283-286) +
-            clear the trash region so dots/axpys see zeros there.
-            self.N is set by _init_model before the first trace."""
+            clear the trash region so dots/axpys see zeros there. ``n`` (the
+            real-parameter count) rides as a runtime argument — baking self.N
+            in at trace time goes stale if run()/load() re-initializes the
+            model on the same learner instance."""
             if gamma != 1:
                 grad = jnp.sign(grad) * jnp.abs(grad) ** gamma
-            return grad.at[self.N:].set(0.0)
+            return jnp.where(jnp.arange(grad.shape[0]) < n, grad, 0.0)
 
-        def reg_objv(weights):
-            return 0.5 * jnp.sum(self.reg_c * weights * weights)
+        def reg_objv(weights, reg_c):
+            return 0.5 * jnp.sum(reg_c * weights * weights)
 
-        def reg_grad(weights):
-            return self.reg_c * weights
+        def reg_grad(weights, reg_c):
+            return reg_c * weights
 
         self._tile_grad = jax.jit(tile_grad, donate_argnums=1)
         self._tile_pred_auc = jax.jit(tile_pred_auc)
@@ -326,7 +336,7 @@ class LBFGSLearner(Learner):
             o, a, grad = self._tile_grad(weights, grad, tile)
             objv += float(o)
             auc += float(a)
-        return objv, auc, self._finish_grad(grad)
+        return objv, auc, self._finish_grad(grad, self._n_real)
 
     # ----------------------------------------------------------- driver
     def run(self) -> None:
@@ -338,7 +348,7 @@ class LBFGSLearner(Learner):
         if p.model_in:
             n = self._warm_start(p.model_in)
             log.info("warm start from %s: %d features matched", p.model_in, n)
-        r0 = float(self._reg_objv(self.weights))
+        r0 = float(self._reg_objv(self.weights, self.reg_c))
         f0, auc, g_loss = self._calc_grad(self.weights)
         objv = r0 + f0
 
@@ -353,7 +363,8 @@ class LBFGSLearner(Learner):
         for epoch in range(k, p.max_num_epochs):
             log.info("epoch %d:", epoch)
             # kPushGradient + kPrepareCalcDirection (lbfgs_updater.h:84-99)
-            new_grads = self._axpy(1.0, self._reg_grad(self.weights), g_loss)
+            new_grads = self._axpy(1.0, self._reg_grad(self.weights, self.reg_c),
+                                   g_loss)
             if grads is None:
                 grads = new_grads
             else:
@@ -395,9 +406,11 @@ class LBFGSLearner(Learner):
                                           self.weights)
                 alpha = trial
                 f_new, auc, g_loss = self._calc_grad(self.weights)
-                new_objv = f_new + float(self._reg_objv(self.weights))
+                new_objv = f_new + float(
+                    self._reg_objv(self.weights, self.reg_c))
                 pg_new = float(self._dot(g_loss, direction)) + float(
-                    self._dot(self._reg_grad(self.weights), direction))
+                    self._dot(self._reg_grad(self.weights, self.reg_c),
+                              direction))
                 log.info(" - alpha = %g, objv = %g, <p,g> = %g",
                          trial, new_objv, pg_new)
                 if (new_objv <= objv + p.c1 * trial * p_gf
@@ -471,3 +484,4 @@ class LBFGSLearner(Learner):
         buf = np.zeros(self.N_pad, dtype=np.float32)
         buf[:self.N] = w
         self.weights = jnp.asarray(buf)
+        self._refresh_layout_constants()

@@ -281,13 +281,20 @@ class SGDLearner(Learner):
         from ..ops.batch import pack_batch
         pending: list = []  # device scalars fetched lazily at the end
         for blk, (cblk, uniq, cnts) in prefetch(produce(), depth=2):
-            u_cap = bucket(len(uniq))
+            slots_np, remap, cnts = self.store.map_keys_dedup(uniq, cnts)
+            if remap is not None:
+                # hashed-mode in-batch collisions: point the COO entries at
+                # the deduped slot rows so colliding features alias (their
+                # gradients segment-sum together on device)
+                cblk = dataclasses.replace(
+                    cblk, index=remap[cblk.index].astype(np.uint32))
+            n_uniq = len(slots_np)
+            u_cap = bucket(n_uniq)
             b_cap, nnz_cap = bucket(blk.size), bucket(blk.nnz)
-            slots_np = self.store.map_keys(uniq)
             if self.mesh is None:
                 # packed path: 2 host->device transfers per batch
                 i32, f32, binary = pack_batch(
-                    cblk, len(uniq), slots_np, b_cap, nnz_cap, u_cap,
+                    cblk, n_uniq, slots_np, b_cap, nnz_cap, u_cap,
                     counts=cnts if push_cnt else None)
                 i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
                 if job_type == K_TRAINING:
@@ -300,7 +307,7 @@ class SGDLearner(Learner):
                         binary)
             else:
                 slots = self.store.pad_slots(slots_np, u_cap)
-                dev = pad_batch(cblk, num_uniq=len(uniq),
+                dev = pad_batch(cblk, num_uniq=n_uniq,
                                 batch_cap=b_cap, nnz_cap=nnz_cap)
                 from ..parallel import batch_sharding, shard_pytree
                 dev = shard_pytree(dev, batch_sharding(self.mesh))

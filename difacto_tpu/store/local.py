@@ -112,6 +112,33 @@ class SlotStore:
         """Slots for known ids, TRASH_SLOT for unknown (no insertion)."""
         return self.map_keys(keys, insert=False)
 
+    def map_keys_dedup(self, keys: np.ndarray,
+                       counts: Optional[np.ndarray] = None):
+        """map_keys + in-batch collision dedup (hashed mode).
+
+        Returns ``(slots, remap, counts)``. ``remap`` is None when the slots
+        are already unique (always, for the dictionary store). In hashed mode
+        distinct ids can collide into one slot within a batch; the scatter
+        kernels (``.at[slots].set``) require unique slots, so collisions must
+        be merged *before* the device step: ``remap[i]`` is the deduped
+        position of input key ``i`` — the caller rewrites its localized COO
+        indices through it, which makes colliding features genuinely alias
+        (their gradients segment-sum into the shared row) instead of
+        nondeterministically dropping one update. ``counts`` are aggregated
+        the same way.
+        """
+        slots = self.map_keys(keys)
+        if not self.hashed:
+            return slots, None, counts
+        uniq, inv = np.unique(slots, return_inverse=True)
+        if len(uniq) == len(slots):
+            return slots, None, counts
+        if counts is not None:
+            counts = np.bincount(
+                inv, weights=counts, minlength=len(uniq)
+            ).astype(np.float32)
+        return uniq.astype(np.int32), inv, counts
+
     def _ensure_capacity(self, need: int) -> None:
         cap = self.state.capacity
         if need <= cap:
@@ -143,7 +170,23 @@ class SlotStore:
     def push(self, keys: np.ndarray, val_type: int,
              gw: np.ndarray, gV: Optional[np.ndarray] = None,
              vmask: Optional[np.ndarray] = None) -> None:
-        slots = jnp.asarray(self.map_keys(keys))
+        slots_np, remap, _ = self.map_keys_dedup(keys)
+        if remap is not None:
+            # hashed-mode in-batch collisions: sum the colliding values so
+            # aliased features accumulate (scatter .set requires unique slots)
+            n = len(slots_np)
+            gw = np.bincount(remap, weights=np.asarray(gw, np.float64),
+                             minlength=n).astype(np.float32)
+            if gV is not None:
+                agg = np.zeros((n,) + np.asarray(gV).shape[1:],
+                               dtype=np.float32)
+                np.add.at(agg, remap, np.asarray(gV))
+                gV = agg
+            if vmask is not None:
+                vm = np.zeros(n, dtype=np.float32)
+                np.maximum.at(vm, remap, np.asarray(vmask, np.float32))
+                vmask = vm
+        slots = jnp.asarray(slots_np)
         if val_type == K_FEACOUNT:
             self.state = self.fns.apply_count(self.state, slots,
                                               jnp.asarray(gw))

@@ -70,6 +70,56 @@ def test_hashed_store_trains(rcv1_path):
                                np.asarray(ln.store.state.w))
 
 
+def test_hashed_push_collision_aggregates():
+    """In-batch slot collisions must alias (sum) the colliding features'
+    updates, not nondeterministically drop one (scatter .set needs unique
+    slots). Keys 5 and 12 both map to slot 6 at hash_capacity=8."""
+    from difacto_tpu.store.local import K_GRADIENT, SlotStore
+    from difacto_tpu.updaters.sgd_updater import SGDUpdaterParam
+
+    param = SGDUpdaterParam(V_dim=2, V_threshold=0, lr=1.0, l1=0.0, l2=0.0,
+                            hash_capacity=8)
+    s1 = SlotStore(param)
+    keys = np.array([5, 12], dtype=np.uint64)
+    assert (s1.map_keys(keys) == 6).all()
+    slots, remap, _ = s1.map_keys_dedup(keys)
+    assert list(slots) == [6] and list(remap) == [0, 0]
+
+    gv = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    s1.push(keys, K_GRADIENT, np.array([1.0, 2.0], np.float32), gv,
+            np.array([1.0, 1.0], np.float32))
+
+    s2 = SlotStore(param)
+    s2.push(np.array([5], dtype=np.uint64), K_GRADIENT,
+            np.array([3.0], np.float32), gv.sum(0, keepdims=True),
+            np.array([1.0], np.float32))
+    np.testing.assert_allclose(np.asarray(s1.state.w), np.asarray(s2.state.w))
+    np.testing.assert_allclose(np.asarray(s1.state.VVg),
+                               np.asarray(s2.state.VVg))
+
+
+def test_hashed_learner_with_heavy_collisions(rcv1_path):
+    """Tiny hash_capacity => every batch has in-batch collisions; the COO
+    remap path must keep training deterministic and finite."""
+    def run():
+        ln = Learner.create("sgd")
+        ln.init([("data_in", rcv1_path), ("V_dim", "2"), ("V_threshold", "0"),
+                 ("lr", "0.1"), ("l1", "0.1"), ("l2", "0"),
+                 ("batch_size", "50"), ("max_num_epochs", "2"),
+                 ("shuffle", "0"), ("report_interval", "0"),
+                 ("stop_rel_objv", "0"), ("num_jobs_per_epoch", "1"),
+                 ("hash_capacity", "64")])
+        seen = []
+        ln.add_epoch_end_callback(lambda e, t, v: seen.append(t.loss))
+        ln.run()
+        return np.asarray(ln.store.state.w), seen
+
+    w1, seen1 = run()
+    w2, seen2 = run()
+    np.testing.assert_array_equal(w1, w2)
+    assert np.isfinite(seen1).all() and seen1 == seen2
+
+
 def test_hashed_store_deterministic_across_instances(rcv1_path):
     """Two independent runs produce identical tables (the multi-controller
     requirement: no insertion-order-dependent state)."""
