@@ -1,0 +1,23 @@
+# Local CI gate — the same checks .github/workflows/ci.yml runs.
+# (Reference analog: Makefile `make test` + .travis.yml.)
+#
+#   make test   - full pytest suite on a virtual 8-device CPU mesh
+#   make smoke  - bench.py + driver entry smoke (catches broken artifacts)
+#   make ci     - both
+
+PY ?= python
+
+.PHONY: test smoke ci
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+smoke:
+	$(PY) bench.py --steps 2 --batch-size 128 --uniq 256 --capacity 1024 --vdim 4
+	$(PY) -c "import jax, __graft_entry__; \
+	fn, args = __graft_entry__.entry(); \
+	jax.block_until_ready(jax.jit(fn)(*args)); \
+	__graft_entry__.dryrun_multichip(8); \
+	print('entry + dryrun ok')"
+
+ci: test smoke

@@ -54,8 +54,8 @@ def run_dump(kwargs: KWArgs) -> KWArgs:
         raise ValueError(f"unknown updater: {param.updater}")
     # V_dim is recorded in the checkpoint; probe it so the store allocates
     # the right row width before load
-    import numpy as np
-    with np.load(param.model_in) as z:
+    from .utils import stream
+    with stream.load_npz(param.model_in) as z:
         v_dim = int(z["V_dim"]) if "V_dim" in z.files else 0
     uparam, remain = SGDUpdaterParam.init_allow_unknown(remain)
     import dataclasses

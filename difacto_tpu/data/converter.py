@@ -10,12 +10,12 @@ fast binary path that keeps TPU chips fed (SURVEY §7 hard part (e)).
 from __future__ import annotations
 
 import logging
-import os
 from dataclasses import dataclass
 
 import numpy as np
 
 from ..config import KWArgs, Param
+from ..utils import stream
 from .reader import Reader
 from .rec import write_rec_block
 from .rowblock import RowBlock
@@ -66,9 +66,9 @@ class Converter:
             ipart += 1
             nwrite = 0
             if p.data_out_format == "libsvm":
-                out = open(path, "w")
+                out = stream.open_stream(path, "w")
             else:
-                os.makedirs(path, exist_ok=True)
+                stream.makedirs(path)
                 out = path  # rec: a directory of npz members
             log.info("writing data to %s in %s format", path,
                      p.data_out_format)
@@ -106,6 +106,6 @@ class Converter:
             data = "\n".join(lines) + "\n"
             out.write(data)
             return len(data)
-        path = os.path.join(out, f"part-{nblk:05d}.npz")
+        path = stream.join(out, f"part-{nblk:05d}.npz")
         write_rec_block(path, blk)
-        return os.path.getsize(path)
+        return stream.getsize(path)

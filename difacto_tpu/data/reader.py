@@ -12,45 +12,55 @@ the first line start at-or-after its begin offset and ends with the line that
 straddles its end offset. Records are yielded in chunks of ``chunk_bytes`` as
 :class:`RowBlock`.
 
-URIs: a file path, a directory (all regular files inside, sorted), or a glob.
-The binary `.rec` cache (rec.py) dispatches on format="rec".
+URIs: a file path, a directory (all regular files inside, sorted), or a glob
+— local, or any fsspec scheme (``gs://``, ``hdfs://``, ``memory://``; the
+reference reads hdfs:// via dmlc InputSplit, example/yarn.conf). The binary
+`.rec` cache (rec.py) dispatches on format="rec".
 """
 
 from __future__ import annotations
 
-import glob as _glob
-import os
 from typing import Iterator, List, Tuple
 
+from ..utils import stream
 from .parsers import get_parser
 from .rowblock import RowBlock
 
 
-def expand_uri(uri: str) -> List[str]:
-    """Expand a uri into a sorted list of files. ';' separates multiple uris."""
+def expand_uri(uri: str, with_sizes: bool = False):
+    """Expand a uri into a sorted list of files. ';' separates multiple uris.
+
+    ``with_sizes`` returns (files, sizes) with sizes batched per directory
+    (one remote listing instead of a stat per file)."""
     files: List[str] = []
+    sizes: List[int] = []
     for part in uri.split(";"):
         part = part.strip()
         if not part:
             continue
-        if os.path.isdir(part):
-            files.extend(
-                os.path.join(part, f) for f in sorted(os.listdir(part))
-                if os.path.isfile(os.path.join(part, f)))
-        elif os.path.isfile(part):
+        if stream.isdir(part):
+            for f, sz in stream.listdir_files(part):
+                files.append(f)
+                sizes.append(sz)
+        elif stream.isfile(part):
             files.append(part)
+            sizes.append(stream.getsize(part) if with_sizes else -1)
         else:
-            hits = sorted(_glob.glob(part))
+            hits = stream.glob(part)
             if not hits:
                 raise FileNotFoundError(f"no files match data uri: {part!r}")
-            files.extend(h for h in hits if os.path.isfile(h))
+            for h in hits:
+                if stream.isfile(h):
+                    files.append(h)
+                    sizes.append(stream.getsize(h) if with_sizes else -1)
+    if with_sizes:
+        return files, sizes
     return files
 
 
-def _byte_ranges(files: List[str], part_idx: int, num_parts: int
-                 ) -> List[Tuple[str, int, int]]:
+def _byte_ranges(files: List[str], sizes: List[int], part_idx: int,
+                 num_parts: int) -> List[Tuple[str, int, int]]:
     """Assign this part's global byte range [begin, end) across files."""
-    sizes = [os.path.getsize(f) for f in files]
     total = sum(sizes)
     begin = total * part_idx // num_parts
     end = total * (part_idx + 1) // num_parts
@@ -72,7 +82,7 @@ def _iter_text_chunks(path: str, begin: int, end: int, chunk_bytes: int,
     included (and the line straddling `begin` excluded) so every line belongs
     to exactly one part.
     """
-    with open(path, "rb") as f:
+    with stream.open_stream(path, "rb") as f:
         pos = begin
         if begin > 0:
             f.seek(begin - 1)
@@ -107,7 +117,7 @@ class Reader:
         self.part_idx = part_idx
         self.num_parts = num_parts
         self.chunk_bytes = chunk_bytes
-        self.files = expand_uri(uri)
+        self.files, self._sizes = expand_uri(uri, with_sizes=True)
         if not self.files:
             raise FileNotFoundError(f"empty data uri: {uri!r}")
         self._it: Iterator[RowBlock] | None = None
@@ -116,11 +126,11 @@ class Reader:
         if self.data_format == "rec":
             from .rec import iter_rec_blocks
             yield from iter_rec_blocks(self.files, self.part_idx,
-                                       self.num_parts)
+                                       self.num_parts, sizes=self._sizes)
             return
         parse = get_parser(self.data_format)
-        for path, b, e in _byte_ranges(self.files, self.part_idx,
-                                       self.num_parts):
+        for path, b, e in _byte_ranges(self.files, self._sizes,
+                                       self.part_idx, self.num_parts):
             for chunk in _iter_text_chunks(path, b, e, self.chunk_bytes):
                 blk = parse(chunk)
                 if blk.size:

@@ -14,32 +14,25 @@ by compressed size — the unit of work-stealing, like recordio parts.
 
 from __future__ import annotations
 
-import io
-import os
 from typing import Iterator, List
 
 import numpy as np
 
+from ..utils import stream
 from .rowblock import RowBlock
 
 
 def write_rec_block(path: str, blk: RowBlock, compress: bool = True) -> None:
-    save = np.savez_compressed if compress else np.savez
     arrays = dict(offset=blk.offset, label=blk.label, index=blk.index)
     if blk.value is not None:
         arrays["value"] = blk.value
     if blk.weight is not None:
         arrays["weight"] = blk.weight
-    buf = io.BytesIO()
-    save(buf, **arrays)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(buf.getvalue())
-    os.replace(tmp, path)
+    stream.save_npz(path, compress=compress, **arrays)
 
 
 def read_rec_block(path: str) -> RowBlock:
-    with np.load(path) as z:
+    with stream.load_npz(path) as z:
         return RowBlock(
             offset=z["offset"],
             label=z["label"],
@@ -49,24 +42,28 @@ def read_rec_block(path: str) -> RowBlock:
         )
 
 
-def rec_members(files: List[str]) -> List[str]:
-    """Resolve to .npz members only — stray files (.tmp from an interrupted
-    writer, READMEs) in a cache dir must not reach np.load."""
-    out: List[str] = []
-    for f in files:
-        if os.path.isdir(f):
-            out.extend(os.path.join(f, m) for m in sorted(os.listdir(f))
+def rec_members(files: List[str], sizes=None) -> List[tuple]:
+    """Resolve to [(member, size)] .npz members only — stray files (.tmp from
+    an interrupted writer, READMEs) in a cache dir must not reach np.load.
+    ``sizes`` parallel to ``files`` avoids a remote stat per member."""
+    out: List[tuple] = []
+    for i, f in enumerate(files):
+        if stream.isdir(f):
+            out.extend((m, sz) for m, sz in stream.listdir_files(f)
                        if m.endswith(".npz"))
         elif f.endswith(".npz"):
-            out.append(f)
+            sz = sizes[i] if sizes is not None and sizes[i] >= 0 \
+                else stream.getsize(f)
+            out.append((f, sz))
     return out
 
 
-def iter_rec_blocks(files: List[str], part_idx: int, num_parts: int
-                    ) -> Iterator[RowBlock]:
+def iter_rec_blocks(files: List[str], part_idx: int, num_parts: int,
+                    sizes=None) -> Iterator[RowBlock]:
     """Yield this part's members, sharded by cumulative compressed size."""
-    members = rec_members(files)
-    sizes = [os.path.getsize(m) for m in members]
+    pairs = rec_members(files, sizes)
+    members = [m for m, _ in pairs]
+    sizes = [sz for _, sz in pairs]
     total = sum(sizes)
     begin = total * part_idx // num_parts
     end = total * (part_idx + 1) // num_parts
@@ -85,10 +82,10 @@ class RecWriter:
         self.out_dir = out_dir
         self.compress = compress
         self._n = 0
-        os.makedirs(out_dir, exist_ok=True)
+        stream.makedirs(out_dir)
 
     def write(self, blk: RowBlock) -> None:
-        path = os.path.join(self.out_dir, f"part-{self._n:05d}.npz")
+        path = stream.join(self.out_dir, f"part-{self._n:05d}.npz")
         write_rec_block(path, blk, self.compress)
         self._n += 1
 

@@ -352,11 +352,12 @@ class BCDLearner(Learner):
 
     def save(self, path: str) -> None:
         """(reference BCDUpdater Save/Load are stubs; we persist anyway)"""
-        np.savez_compressed(self._ckpt_path(path), feaids=self.feaids,
-                            w=self.w)
+        from ..utils import stream
+        stream.save_npz(self._ckpt_path(path), feaids=self.feaids, w=self.w)
 
     def load(self, path: str) -> None:
-        with np.load(self._ckpt_path(path)) as z:
+        from ..utils import stream
+        with stream.load_npz(self._ckpt_path(path)) as z:
             pos = find_position(z["feaids"].astype(FEAID_DTYPE), self.feaids)
             ok = pos >= 0
             self.w[ok] = z["w"][pos[ok]]

@@ -219,7 +219,8 @@ class LBFGSLearner(Learner):
         """Copy checkpoint weights into the current layout (model_in warm
         start, lbfgs_param.h model_in). Features present in both with the
         same row length take the saved values; the rest keep their init."""
-        with np.load(self._ckpt_path(path)) as z:
+        from ..utils import stream
+        with stream.load_npz(self._ckpt_path(path)) as z:
             if int(z["V_dim"]) != self.k:
                 raise ValueError("checkpoint V_dim mismatch")
             ck_ids, ck_lens, ck_w = z["feaids"], z["lens"], z["weights"]
@@ -462,13 +463,15 @@ class LBFGSLearner(Learner):
     def save(self, path: str) -> None:
         """Flat-model checkpoint (the reference LBFGSUpdater's Save/Load are
         empty stubs, lbfgs_updater.h:22-24; we persist anyway)."""
-        np.savez_compressed(self._ckpt_path(path), feaids=self.feaids,
-                            lens=self.lens,
-                            weights=np.asarray(self.weights)[:self.N],
-                            V_dim=np.array(self.k))
+        from ..utils import stream
+        stream.save_npz(self._ckpt_path(path), feaids=self.feaids,
+                        lens=self.lens,
+                        weights=np.asarray(self.weights)[:self.N],
+                        V_dim=np.array(self.k))
 
     def load(self, path: str) -> None:
-        with np.load(self._ckpt_path(path)) as z:
+        from ..utils import stream
+        with stream.load_npz(self._ckpt_path(path)) as z:
             if int(z["V_dim"]) != self.k:
                 raise ValueError("checkpoint V_dim mismatch")
             self.feaids = z["feaids"]

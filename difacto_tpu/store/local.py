@@ -21,13 +21,13 @@ log2(total/initial) times overall).
 
 from __future__ import annotations
 
-import os
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..base import FEAID_DTYPE, reverse_bytes
+from ..utils import stream
 from ..updaters.sgd_updater import (SGDState, SGDUpdaterParam, TRASH_SLOT,
                                     grow_state, init_state, make_fns)
 
@@ -233,9 +233,7 @@ class SlotStore:
                               (("w", "cnt", "v_live", "V") + (
                                   ("z", "sqrt_g", "Vg") if save_aux
                                   else ()))})
-            tmp = path + ".tmp.npz"
-            np.savez_compressed(tmp, **arrays)
-            os.replace(tmp, path)
+            stream.save_npz(path, **arrays)
             return int((st["w"] != 0).sum())
         keys, slots = self._sorted_items()
         st = self._state_np(self.state)
@@ -255,13 +253,11 @@ class SlotStore:
         if save_aux:
             arrays.update(z=st["z"][slots], sqrt_g=st["sqrt_g"][slots],
                           Vg=st["Vg"][slots])
-        tmp = path + ".tmp.npz"  # .npz suffix stops savez appending its own
-        np.savez_compressed(tmp, **arrays)
-        os.replace(tmp, path)
+        stream.save_npz(path, **arrays)
         return len(keys)
 
     def load(self, path: str) -> int:
-        with np.load(path) as z:
+        with stream.load_npz(path) as z:
             if self.hashed != ("hash_capacity" in z.files):
                 raise ValueError(
                     "checkpoint store mode mismatch: "
@@ -333,7 +329,7 @@ class SlotStore:
             keys, slots = self._sorted_items()
         st = self._state_np(self.state)
         n = 0
-        with open(path, "w") as f:
+        with stream.open_stream(path, "w") as f:
             for k, s in zip(keys, slots):
                 w = st["w"][s]
                 live = bool(st["v_live"][s]) and self.param.V_dim > 0

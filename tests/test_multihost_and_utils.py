@@ -70,6 +70,16 @@ def test_hashed_store_trains(rcv1_path):
                                np.asarray(ln.store.state.w))
 
 
+def test_multihost_dictionary_store_rejected(rcv1_path, monkeypatch):
+    """Multi-host + dictionary store must error (per-host slot assignment
+    would train independent replicas), pointing at hash_capacity."""
+    import difacto_tpu.parallel.multihost as mh
+    monkeypatch.setattr(mh, "host_part", lambda: (0, 2))
+    ln = Learner.create("sgd")
+    with pytest.raises(ValueError, match="hash_capacity"):
+        ln.init([("data_in", rcv1_path)])
+
+
 def test_hashed_push_collision_aggregates():
     """In-batch slot collisions must alias (sum) the colliding features'
     updates, not nondeterministically drop one (scatter .set needs unique

@@ -1,0 +1,97 @@
+"""Remote-storage IO: fsspec URIs behind Reader / checkpoint / convert paths
+(the dmlc Stream equivalent — reference reads hdfs:// via dmlc InputSplit,
+example/yarn.conf). Tested against fsspec's memory:// filesystem."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("fsspec")
+
+from difacto_tpu.data import Reader
+from difacto_tpu.learners import Learner
+from difacto_tpu.utils import stream
+
+
+@pytest.fixture
+def memfs():
+    import fsspec
+    fs = fsspec.filesystem("memory")
+    yield fs
+    try:
+        fs.rm("/", recursive=True)
+    except FileNotFoundError:
+        pass
+
+
+def test_stream_helpers_roundtrip(memfs):
+    uri = "memory://dir/a.txt"
+    with stream.open_stream(uri, "wb") as f:
+        f.write(b"hello\nworld\n")
+    assert stream.exists(uri) and stream.isfile(uri)
+    assert stream.getsize(uri) == 12
+    assert stream.isdir("memory://dir")
+    assert any(p.endswith("a.txt") for p in stream.listdir("memory://dir"))
+    assert any(p.endswith("a.txt") for p in stream.glob("memory://dir/*.txt"))
+    with stream.open_stream(uri, "rb") as f:
+        assert f.read() == b"hello\nworld\n"
+
+
+def test_npz_roundtrip_remote(memfs):
+    uri = "memory://models/ck.npz"
+    a = np.arange(10, dtype=np.float32)
+    stream.save_npz(uri, a=a, b=np.array(3))
+    with stream.load_npz(uri) as z:
+        np.testing.assert_array_equal(z["a"], a)
+        assert int(z["b"]) == 3
+
+
+def test_reader_over_memory_fs(memfs, rcv1_path):
+    """Byte-range sharded reading from a remote URI matches local."""
+    data = open(rcv1_path, "rb").read()
+    with stream.open_stream("memory://data/rcv1.libsvm", "wb") as f:
+        f.write(data)
+    local = [b for b in Reader(rcv1_path, "libsvm", 0, 2)]
+    remote = [b for b in Reader("memory://data/rcv1.libsvm", "libsvm", 0, 2)]
+    assert sum(b.size for b in local) == sum(b.size for b in remote)
+    np.testing.assert_array_equal(
+        np.concatenate([b.label for b in local]),
+        np.concatenate([b.label for b in remote]))
+
+
+def test_train_with_remote_model_out(memfs, rcv1_path):
+    """Full train with model_out and pred_out on the remote fs, then load
+    the checkpoint back from the URI."""
+    with stream.open_stream("memory://in/rcv1.libsvm", "wb") as f:
+        f.write(open(rcv1_path, "rb").read())
+    args = [("data_in", "memory://in/rcv1.libsvm"), ("V_dim", "0"),
+            ("l1", "1"), ("l2", "1"), ("lr", "1"), ("batch_size", "100"),
+            ("max_num_epochs", "3"), ("shuffle", "0"),
+            ("report_interval", "0"), ("stop_rel_objv", "0"),
+            ("num_jobs_per_epoch", "1"),
+            ("model_out", "memory://out/model")]
+    ln = Learner.create("sgd")
+    ln.init(list(args))
+    ln.run()
+    assert stream.exists("memory://out/model_part-0")
+
+    l2 = Learner.create("sgd")
+    l2.init(list(args))
+    n = l2.store.load("memory://out/model_part-0")
+    assert n > 0
+    # slot order differs after load (sorted-key assignment); compare by key
+    keys = l2.store._keys.copy()
+    np.testing.assert_allclose(l2.store.pull(keys)[0], ln.store.pull(keys)[0])
+
+
+def test_rec_convert_to_remote(memfs, rcv1_path):
+    """task=convert writing the binary cache to a remote URI, then stream
+    training from it."""
+    from difacto_tpu.data.converter import Converter
+
+    conv = Converter()
+    conv.init([("data_in", rcv1_path), ("data_format", "libsvm"),
+               ("data_out", "memory://cache/rcv1.rec"),
+               ("data_out_format", "rec")])
+    conv.run()
+    blocks = [b for b in Reader("memory://cache/rcv1.rec", "rec", 0, 1)]
+    assert sum(b.size for b in blocks) == 100
