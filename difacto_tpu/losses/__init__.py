@@ -9,7 +9,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from .fm import FMParams, fm_grad, fm_predict, logit_objv
+from .fm import (FMParams, fm_grad, fm_grad_panel, fm_predict,
+                 fm_predict_panel, logit_objv)
 from . import metrics
 
 
@@ -19,9 +20,15 @@ class LossSpec:
     V_dim: int
 
     def predict(self, params: FMParams, batch):
+        from ..ops.batch import PanelBatch
+        if isinstance(batch, PanelBatch):
+            return fm_predict_panel(params, batch)
         return fm_predict(params, batch)
 
     def calc_grad(self, params: FMParams, batch, pred):
+        from ..ops.batch import PanelBatch
+        if isinstance(batch, PanelBatch):
+            return fm_grad_panel(params, batch, pred)
         return fm_grad(params, batch, pred)
 
     def evaluate(self, pred, batch):
@@ -37,5 +44,6 @@ def create(name: str, V_dim: int = 0) -> LossSpec:
     raise ValueError(f"unknown loss type: {name!r}")
 
 
-__all__ = ["FMParams", "fm_predict", "fm_grad", "logit_objv", "LossSpec",
+__all__ = ["FMParams", "fm_predict", "fm_grad", "fm_predict_panel",
+           "fm_grad_panel", "logit_objv", "LossSpec",
            "create", "metrics"]

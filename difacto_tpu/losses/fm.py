@@ -24,6 +24,7 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from ..ops.batch import DeviceBatch
@@ -79,6 +80,82 @@ def fm_grad(params: FMParams, batch: DeviceBatch, pred: jnp.ndarray
     t1 = spmm_t(batch.vals, batch.rows, batch.cols, p[:, None] * XV, U)
     # diag((X.X)'p) V
     xxp = spmv_t(batch.vals ** 2, batch.rows, batch.cols, p, U)
+    gV = (t1 - xxp[:, None] * Vm) * vm[:, None]
+    return gw, gV
+
+
+def fm_predict_panel(params: FMParams, pb) -> jnp.ndarray:
+    """Panel-layout forward (ops/batch.py PanelBatch): ONE [B,F]-cell
+    gather of combined [w | V] rows, then dense reductions over the fixed
+    row width — no COO segment machinery. Same arithmetic as fm_predict
+    (fm_loss.h:43,67-119)."""
+    if params.V is None or params.V.shape[1] == 0:
+        wc = params.w[pb.idx]                       # [B, F]
+        if pb.vals is not None:
+            wc = wc * pb.vals
+        return jnp.clip(jnp.sum(wc, axis=1), -PRED_CLAMP, PRED_CLAMP)
+    # the [U, 1+k] combined rows keep V's STORAGE dtype: with bf16 V_dtype
+    # the per-token gather (the step's largest stream at big batches)
+    # moves half the bytes; accumulation is f32 below
+    dt = params.V.dtype
+    Vm = params.V * _vmask(params).astype(dt)[:, None]
+    wv = jnp.concatenate([params.w.astype(dt)[:, None], Vm], axis=1)
+    tok = wv[pb.idx]                                 # [B, F, 1+k]
+    wc, t = tok[:, :, 0].astype(jnp.float32), tok[:, :, 1:]
+    if pb.vals is not None:
+        wc = wc * pb.vals
+        t = t * pb.vals[:, :, None].astype(dt)       # t = val * V
+    t = t.astype(jnp.float32)
+    pred = jnp.sum(wc, axis=1)
+    XV = jnp.sum(t, axis=1)
+    XXVV = jnp.sum(t * t, axis=1)
+    pred = pred + 0.5 * jnp.sum(XV * XV - XXVV, axis=1)
+    return jnp.clip(pred, -PRED_CLAMP, PRED_CLAMP)
+
+
+def fm_grad_panel(params: FMParams, pb, pred: jnp.ndarray
+                  ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Panel-layout backward: per-cell contributions are pure BROADCASTS
+    of row quantities (p, p*XV), merged by ONE combined segment reduction
+    [B*F, k+2] -> [U, k+2] for (t1 | gw | xxp). Same math as fm_grad
+    (fm_loss.h:124-126,148-203)."""
+    U = params.w.shape[0]
+    B, F = pb.idx.shape
+    p = _p_vector(pred, pb)                          # [B]
+    flat_idx = pb.idx.reshape(B * F)
+    if params.V is None or params.V.shape[1] == 0:
+        cell = jnp.broadcast_to(p[:, None], (B, F))
+        if pb.vals is not None:
+            cell = cell * pb.vals
+        gw = jax.ops.segment_sum(cell.reshape(B * F), flat_idx,
+                                 num_segments=U)
+        return gw, None
+    k = params.V.shape[1]
+    vm = _vmask(params)
+    Vm = (params.V * vm.astype(params.V.dtype)[:, None])
+    # recompute XV from the forward's gather (cheap relative to a cache);
+    # storage-dtype gather, f32 accumulation (see fm_predict_panel)
+    t = Vm[pb.idx]
+    if pb.vals is not None:
+        t = t * pb.vals[:, :, None].astype(t.dtype)
+    XV = jnp.sum(t.astype(jnp.float32), axis=1)
+    Vm = Vm.astype(jnp.float32)
+    pXV = p[:, None] * XV                            # [B, k]
+    contrib = jnp.concatenate([
+        jnp.broadcast_to(pXV[:, None, :], (B, F, k)),
+        jnp.broadcast_to(p[:, None, None], (B, F, 1)),   # -> gw
+        jnp.broadcast_to(p[:, None, None], (B, F, 1)),   # -> xxp
+    ], axis=2)
+    if pb.vals is not None:
+        v3 = pb.vals[:, :, None]
+        contrib = contrib * jnp.concatenate(
+            [jnp.broadcast_to(v3, (B, F, k + 1)), v3 * v3], axis=2)
+    # the [B*F, k+2] contribution stream rides the storage dtype (bf16
+    # when V_dtype is bf16: per-cell rounding only); accumulation into the
+    # per-feature sums stays float32 via the scatter-add's output buffer
+    red = jnp.zeros((U, k + 2), jnp.float32).at[flat_idx].add(
+        contrib.astype(params.V.dtype).reshape(B * F, k + 2))
+    t1, gw, xxp = red[:, :k], red[:, k], red[:, k + 1]
     gV = (t1 - xxp[:, None] * Vm) * vm[:, None]
     return gw, gV
 

@@ -49,10 +49,98 @@ class DeviceBatch(NamedTuple):
         return self.vals.shape[0]
 
 
+class PanelBatch(NamedTuple):
+    """Fixed-width row panel: the TPU-preferred batch layout.
+
+    Criteo rows have exactly 39 features (13 int + 26 categorical,
+    src/reader/criteo_parser.h:25-115); a [B, F] index matrix turns the
+    forward into one gather + dense reductions and the backward into pure
+    broadcasts + ONE segment reduction — no per-token COO gathers at all.
+    Ragged data still packs here when rows are near-uniform (pad cells:
+    idx 0 with val 0); heavily skewed rows fall back to DeviceBatch COO.
+    """
+    idx: jnp.ndarray       # int32[B, F] positions into the slot vector
+    vals: Optional[jnp.ndarray]  # f32[B, F] or None (binary, no padding)
+    labels: jnp.ndarray    # f32[B]
+    rweight: jnp.ndarray   # f32[B]
+    row_mask: jnp.ndarray  # f32[B] 1 for real rows
+    num_rows: jnp.ndarray  # i32[]
+    num_uniq: jnp.ndarray  # i32[]
+
+    @property
+    def batch_cap(self) -> int:
+        return self.labels.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.idx.shape[1]
+
+
+def panel_width(blk: RowBlock, batch_cap: int) -> Optional[int]:
+    """Fixed panel width for this block, or None when the COO layout is
+    denser. Panel wins when B*F_max stays within ~1.5x the COO nnz pad."""
+    counts = np.diff(blk.offset)
+    if len(counts) == 0:
+        return None
+    fmax = int(counts.max())
+    if fmax == 0:
+        return None
+    coo_cells = bucket(blk.nnz)
+    if batch_cap * fmax <= 1.5 * coo_cells:
+        return fmax
+    return None
+
+
+def pad_panel(blk: RowBlock, num_uniq: int, batch_cap: int, width: int
+              ) -> PanelBatch:
+    """Pack a *localized* row block into a PanelBatch."""
+    b = blk.size
+    counts = np.diff(blk.offset).astype(np.int64)
+    if counts.size and counts.max() > width:
+        raise ValueError(f"row nnz {counts.max()} exceeds panel width "
+                         f"{width}")
+    idx = np.zeros((batch_cap, width), dtype=np.int32)
+    uniform = counts.size and (counts == width).all()
+    if uniform and b == batch_cap:
+        idx[:] = blk.index.reshape(b, width)
+        vals = (None if blk.value is None
+                else blk.value.reshape(b, width).astype(REAL_DTYPE))
+    else:
+        vals_np = np.zeros((batch_cap, width), dtype=REAL_DTYPE)
+        starts = np.asarray(blk.offset[:-1], dtype=np.int64)
+        cell = (np.arange(blk.nnz, dtype=np.int64)
+                - np.repeat(starts - blk.offset[0], counts))
+        rows_coo = np.repeat(np.arange(b, dtype=np.int64), counts)
+        idx[rows_coo, cell] = blk.index.astype(np.int32)
+        vals_np[rows_coo, cell] = blk.values_or_ones()
+        vals = vals_np
+
+    labels = np.zeros(batch_cap, dtype=REAL_DTYPE)
+    labels[:b] = blk.label
+    rweight = np.zeros(batch_cap, dtype=REAL_DTYPE)
+    rweight[:b] = blk.weight if blk.weight is not None else 1.0
+    row_mask = np.zeros(batch_cap, dtype=REAL_DTYPE)
+    row_mask[:b] = 1.0
+    return PanelBatch(
+        idx=jnp.asarray(idx),
+        vals=None if vals is None else jnp.asarray(vals),
+        labels=jnp.asarray(labels), rweight=jnp.asarray(rweight),
+        row_mask=jnp.asarray(row_mask),
+        num_rows=jnp.asarray(b, dtype=jnp.int32),
+        num_uniq=jnp.asarray(num_uniq, dtype=jnp.int32),
+    )
+
+
 def bucket(n: int, minimum: int = 8) -> int:
-    """Round up to the next power of two (>= minimum)."""
+    """Round up to the next bucket rung (>= minimum).
+
+    Rungs are {2^k, 1.5*2^k}: at most 33% padding waste instead of 2x,
+    while staying divisible by every mesh axis size up to 2^(k-1) (1.5*2^k
+    = 3*2^(k-1)) so sharded dimensions still split evenly."""
     b = minimum
     while b < n:
+        if n <= b + b // 2:
+            return b + b // 2
         b *= 2
     return b
 
@@ -71,8 +159,16 @@ def pack_batch(blk: RowBlock, num_uniq: int, slots: np.ndarray,
     ``unpack_batch`` is the jit-side inverse.
     """
     b, nnz = blk.size, blk.nnz
-    if b > batch_cap or nnz > nnz_cap or len(slots) > u_cap:
+    if b > batch_cap or nnz > nnz_cap:
         raise ValueError("batch exceeds caps")
+    if len(slots) != u_cap:
+        # the device kernels declare sorted+unique indices; a short vector
+        # zero-padded here would put TRASH_SLOT=0 after larger slots and
+        # break both declarations — callers must pre-pad with
+        # store.local.pad_slots_oob (ascending out-of-bounds padding)
+        raise ValueError(
+            f"slots must arrive pre-padded to u_cap={u_cap} "
+            f"(got {len(slots)}); use pad_slots_oob")
     binary = blk.value is None
     # trailing 3 ints: [b, num_uniq, nnz] — kept in the i32 buffer so they
     # stay exact (f32 would round past 2^24)
@@ -80,8 +176,7 @@ def pack_batch(blk: RowBlock, num_uniq: int, slots: np.ndarray,
     i32[:nnz] = blk.row_ids()
     i32[nnz:nnz_cap] = max(b - 1, 0)  # pad rows -> a real segment, vals 0
     i32[nnz_cap:nnz_cap + nnz] = blk.index.astype(np.int32)
-    i32[2 * nnz_cap:2 * nnz_cap + len(slots)] = slots
-    # slot padding stays 0 == trash slot
+    i32[2 * nnz_cap:2 * nnz_cap + u_cap] = slots
     i32[2 * nnz_cap + u_cap:] = (b, num_uniq, nnz)
 
     vals_n = 0 if binary else nnz_cap

@@ -50,6 +50,20 @@ def make_mesh(dp: int = 1, fs: int = 1,
         devices = jax.devices()
     if len(devices) < n:
         raise ValueError(f"need {n} devices, have {len(devices)}")
+    if jax.process_count() > 1:
+        # multi-controller: the fs axis must stay intra-host so every host
+        # holds a complete copy of the fs-sharded table (dp replicates it
+        # across hosts) — required by checkpointing/evaluate host reads
+        # (multihost.to_local_numpy) and by ICI-local table collectives
+        lcl = jax.local_device_count()
+        if n != len(devices):
+            raise ValueError(
+                f"multi-host meshes must use every device: dp*fs={n} != "
+                f"{len(devices)} global devices")
+        if fs > lcl or lcl % fs:
+            raise ValueError(
+                f"mesh fs={fs} must divide the local device count {lcl} "
+                "(the feature-sharded table must be host-complete)")
     arr = np.asarray(devices[:n]).reshape(dp, fs)
     return Mesh(arr, (DP_AXIS, FS_AXIS))
 
@@ -81,10 +95,44 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def put_global(arr, sharding: NamedSharding):
+    """Place a host array under ``sharding``, working across processes.
+
+    Single-process: plain device_put. Multi-process: the sharding spans
+    devices this host cannot address, so each process contributes its
+    addressable pieces via make_array_from_callback — every host must pass
+    the same value (true for replicated inputs and for deterministic
+    same-seed state init)."""
+    if all(d.process_index == jax.process_index()
+           for d in sharding.device_set):
+        return jax.device_put(arr, sharding)
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+def put_dp_local(local_arr, mesh: Mesh):
+    """Build the global dp-sharded array from this process's local block.
+
+    The global leading axis is the concatenation of every host's block in
+    process order (the mesh's dp axis is laid out host-major).
+    """
+    local_arr = np.asarray(local_arr)
+    sharding = NamedSharding(
+        mesh, P(DP_AXIS, *([None] * (local_arr.ndim - 1))))
+    if jax.process_count() == 1:
+        return jax.device_put(local_arr, sharding)
+    global_shape = (local_arr.shape[0] * jax.process_count(),
+                    *local_arr.shape[1:])
+    return jax.make_array_from_process_local_data(sharding, local_arr,
+                                                  global_shape)
+
+
 def shard_pytree(tree, spec_fn):
-    """device_put every leaf with its NamedSharding from spec_fn(leaf)."""
+    """Place every leaf with its NamedSharding from spec_fn(leaf);
+    process-count aware (see put_global)."""
     return jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, spec_fn(x)), tree)
+        lambda x: put_global(x, spec_fn(x)), tree)
 
 
 def sharding_tree(tree, spec_fn):

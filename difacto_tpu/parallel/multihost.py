@@ -50,6 +50,69 @@ def initialize(coordinator_address: Optional[str] = None,
              len(jax.devices()))
 
 
+def allgather_np(arr) -> "np.ndarray":
+    """Gather a fixed-shape host numpy array from every process ->
+    [n_procs, *shape]. The DCN control channel of the synchronized-step
+    schedule (the analog of ps-lite's scheduler barrier + key exchange,
+    src/store/kvstore_dist.h:61-70). Single process: adds the leading axis.
+    """
+    import jax
+    import numpy as np
+    if jax.process_count() == 1:
+        return np.asarray(arr)[None]
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(np.asarray(arr)))
+
+
+def to_local_numpy(arr) -> "np.ndarray":
+    """Assemble a (possibly multi-host) jax.Array into a full host numpy
+    array from this process's addressable shards.
+
+    Valid when every piece of the array is present on some local device —
+    true for our layout, where the table is sharded over the intra-host
+    ``fs`` axis and replicated over the cross-host ``dp`` axis. np.asarray
+    would refuse (the sharding spans non-addressable devices) even though
+    the data is all here.
+    """
+    import numpy as np
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    out = np.empty(arr.shape, dtype=arr.dtype)
+    seen = np.zeros(arr.shape[0] if arr.ndim else 1, dtype=bool)
+    for sh in arr.addressable_shards:
+        out[sh.index] = np.asarray(sh.data)
+        seen[sh.index[0] if sh.index else slice(None)] = True
+    if not seen.all():
+        raise ValueError(
+            "array is not host-complete: some shards live only on other "
+            "hosts (expected fs-sharded-within-host layout)")
+    return out
+
+
+def local_rows(arr, lo: int, hi: int) -> "np.ndarray":
+    """Rows [lo, hi) of a (possibly dp-sharded) global array, assembled
+    from this process's addressable shards — np.asarray would refuse on a
+    multi-host sharding even though these rows live here."""
+    import numpy as np
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)[lo:hi]
+    out = np.zeros((hi - lo,) + arr.shape[1:], dtype=arr.dtype)
+    filled = np.zeros(hi - lo, dtype=bool)
+    for sh in arr.addressable_shards:
+        sl = sh.index[0] if sh.index else slice(None)
+        start = sl.start or 0
+        stop = arr.shape[0] if sl.stop is None else sl.stop
+        s, e = max(start, lo), min(stop, hi)
+        if s < e:
+            data = np.asarray(sh.data)
+            out[s - lo:e - lo] = data[s - start:e - start]
+            filled[s - lo:e - lo] = True
+    if not filled.all():
+        raise ValueError(
+            f"rows [{lo}, {hi}) are not all addressable on this host")
+    return out
+
+
 def host_part() -> Tuple[int, int]:
     """(part_idx, num_parts) for this host's share of the input files —
     the multi-controller analog of the reference's Rank()/NumWorkers()

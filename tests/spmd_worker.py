@@ -1,0 +1,43 @@
+"""Multi-host SPMD worker: run by tests/test_multihost_spmd.py through
+launch.py with 2 processes, each holding 4 virtual CPU devices, training
+over a global (dp=2, fs=4) mesh. Dumps the per-epoch loss trajectory as
+JSON so the parent can compare ranks against the single-host reference.
+
+Usage: spmd_worker.py <out_dir> <data_path> [epochs]
+"""
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from difacto_tpu.parallel.multihost import initialize  # noqa: E402
+
+initialize()
+
+from difacto_tpu.learners import Learner  # noqa: E402
+
+out_dir, data = sys.argv[1], sys.argv[2]
+epochs = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+
+ln = Learner.create("sgd")
+ln.init([("data_in", data), ("V_dim", "2"), ("V_threshold", "2"),
+         ("lr", "0.1"), ("l1", "0.1"), ("l2", "0"),
+         ("batch_size", "100"), ("max_num_epochs", str(epochs)),
+         ("shuffle", "0"), ("report_interval", "0"),
+         ("stop_rel_objv", "0"), ("num_jobs_per_epoch", "1"),
+         ("hash_capacity", str(1 << 20)),
+         ("mesh_dp", "2"), ("mesh_fs", "4"),
+         ("model_out", os.path.join(out_dir, "model"))])
+seen = []
+ln.add_epoch_end_callback(lambda e, t, v: seen.append(t.loss))
+ln.run()
+
+rank = jax.process_index()
+with open(os.path.join(out_dir, f"traj-{rank}.json"), "w") as f:
+    json.dump(seen, f)
+print(f"rank {rank} done: {seen}")

@@ -1,0 +1,67 @@
+"""Multi-host x mesh synchronized stepping (round-1 verdict item 4).
+
+Two processes launched through launch.py, each with 4 virtual CPU devices,
+train over a global (dp=2, fs=4) mesh with the hashed store. The per-step
+global batch is the union of both hosts' local batches, so the trajectory
+must match a single-host run over the same data with the same
+hash_capacity (reference analog: ps-lite rendezvous + synchronized
+barriers, src/store/kvstore_dist.h:61-70)."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+EPOCHS = 4
+
+
+def _single_host_reference(rcv1_path):
+    from difacto_tpu.learners import Learner
+    ln = Learner.create("sgd")
+    ln.init([("data_in", rcv1_path), ("V_dim", "2"), ("V_threshold", "2"),
+             ("lr", "0.1"), ("l1", "0.1"), ("l2", "0"),
+             ("batch_size", "100"), ("max_num_epochs", str(EPOCHS)),
+             ("shuffle", "0"), ("report_interval", "0"),
+             ("stop_rel_objv", "0"), ("num_jobs_per_epoch", "1"),
+             ("hash_capacity", str(1 << 20))])
+    seen = []
+    ln.add_epoch_end_callback(lambda e, t, v: seen.append(t.loss))
+    ln.run()
+    return seen
+
+
+def test_two_process_mesh_matches_single_host(rcv1_path, tmp_path):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker sets its own 4-device flag
+    env["PYTHONPATH"] = str(REPO)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "launch.py"), "-n", "2",
+         "--port", "7921", "--",
+         sys.executable, str(REPO / "tests" / "spmd_worker.py"),
+         str(tmp_path), rcv1_path, str(EPOCHS)],
+        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\n" \
+                                 f"stderr:\n{proc.stderr}"
+
+    trajs = []
+    for rank in range(2):
+        with open(tmp_path / f"traj-{rank}.json") as f:
+            trajs.append(json.load(f))
+    # both ranks observed the identical global trajectory
+    np.testing.assert_allclose(trajs[0], trajs[1], rtol=0, atol=0)
+    assert len(trajs[0]) == EPOCHS
+
+    # and it matches the single-host run over the same data: each host read
+    # half the file (byte-range parts), the per-step union batch = the
+    # single host's 100-row batch
+    ref = _single_host_reference(rcv1_path)
+    np.testing.assert_allclose(trajs[0], ref, rtol=2e-4)
+
+    # per-rank checkpoints were written by both hosts
+    assert (tmp_path / "model_part-0").exists()
+    assert (tmp_path / "model_part-1").exists()
