@@ -172,6 +172,27 @@ class SGDLearner(Learner):
         self._packed_eval = jax.jit(packed_eval,
                                     static_argnums=(3, 4, 5, 6))
 
+        from ..ops.batch import unpack_panel
+
+        def packed_panel_train(state, i32, f32, b_cap, width, u_cap,
+                               has_cnt, binary):
+            pb, slots, counts = unpack_panel(i32, f32, b_cap, width, u_cap,
+                                             has_cnt, binary)
+            if counts is not None:
+                state = fns.apply_count(state, slots, counts)
+            return train_step(state, pb, slots)
+
+        def packed_panel_eval(state, i32, f32, b_cap, width, u_cap, binary):
+            pb, slots, _ = unpack_panel(i32, f32, b_cap, width, u_cap,
+                                        binary=binary)
+            return eval_step(state, pb, slots)
+
+        self._packed_panel_train = jax.jit(packed_panel_train,
+                                           donate_argnums=0,
+                                           static_argnums=(3, 4, 5, 6, 7))
+        self._packed_panel_eval = jax.jit(packed_panel_eval,
+                                          static_argnums=(3, 4, 5, 6))
+
     # ----------------------------------------------------------- driver
     def run(self) -> None:
         """RunScheduler (sgd_learner.cc:52-122)."""
@@ -421,6 +442,45 @@ class SGDLearner(Learner):
             prog.merge(Progress(nrows=nrows, loss=float(np.asarray(objv)),
                                 auc=float(np.asarray(auc))))
 
+    def _prepare_hashed(self, blk, push_cnt: bool, dim_min: int):
+        """Producer-thread batch preparation for the hashed store: ONE
+        int32 np.unique collapses localization (Localizer::Compact),
+        key->slot mapping, and collision dedup, then the batch packs into
+        the two-buffer transfer — panel layout when rows are near-uniform
+        (criteo), COO otherwise. Stateless, so safe off-thread."""
+        from ..base import reverse_bytes
+        from ..ops.batch import pack_panel, panel_width
+        from ..store.local import pad_slots_oob
+
+        cap = np.uint64(self.store.param.hash_capacity - 1)
+        tok = (reverse_bytes(blk.index) % cap + np.uint64(1)).astype(
+            np.int32)
+        if push_cnt:
+            slots, inverse, counts = np.unique(
+                tok, return_inverse=True, return_counts=True)
+            counts = counts.astype(np.float32)
+        else:
+            slots, inverse = np.unique(tok, return_inverse=True)
+            counts = None
+        cblk = dataclasses.replace(blk, index=inverse.astype(np.uint32))
+        n_uniq = len(slots)
+        u_cap = bucket(n_uniq)
+        b_cap = bucket(blk.size, dim_min)
+        padded = pad_slots_oob(slots.astype(np.int32), u_cap,
+                               self.store.param.hash_capacity)
+        width = panel_width(cblk, b_cap)
+        if width is not None:
+            i32, f32, binary = pack_panel(
+                cblk, n_uniq, padded, b_cap, width, u_cap,
+                counts=counts if push_cnt else None)
+            return ("panel", i32, f32, binary, b_cap, width, u_cap)
+        from ..ops.batch import pack_batch
+        nnz_cap = bucket(blk.nnz, dim_min)
+        i32, f32, binary = pack_batch(
+            cblk, n_uniq, padded, b_cap, nnz_cap, u_cap,
+            counts=counts if push_cnt else None)
+        return ("coo", i32, f32, binary, b_cap, nnz_cap, u_cap)
+
     def _iterate_data(self, job_type: int, epoch: int, part_idx: int,
                       num_parts: int, prog: Progress) -> None:
         """IterateData (sgd_learner.cc:201-317) — fused-step version."""
@@ -435,25 +495,65 @@ class SGDLearner(Learner):
         g_num = num_parts * self._num_hosts
         reader = self._make_reader(job_type, epoch, g_idx, g_num)
 
-        def produce():
-            # parsing + localization on the producer thread; store access
-            # (key mapping, state) stays on the consumer side
-            for blk in reader:
-                yield blk, compact(blk, need_counts=push_cnt)
-
-        from ..data.prefetch import prefetch
-        from ..ops.batch import pack_batch
-        pending: list = []  # device scalars fetched lazily at the end
         # sharded batch dims must divide the dp axis: force bucket rungs
         # whose every value is a multiple of mesh_dp (rungs >= 2*dp are
         # {2^k, 3*2^(k-1)} with 2^(k-1) >= dp)
         dim_min = 8 if self.mesh is None else max(8, 2 * self.param.mesh_dp)
-        for blk, (cblk, uniq, cnts) in prefetch(produce(), depth=2):
+        hashed_fast = self.store.hashed and self.mesh is None
+
+        def produce():
+            # EVERYTHING host-side happens on the producer thread so it
+            # overlaps device execution. Hashed mode is stateless (no
+            # dictionary), so localization AND packing move here; the
+            # dictionary store mutates host state on insert, so only
+            # parse+compact runs here and the consumer maps keys.
+            for blk in reader:
+                if hashed_fast:
+                    yield "ready", blk, self._prepare_hashed(blk, push_cnt,
+                                                             dim_min)
+                else:
+                    yield "compact", blk, compact(blk, need_counts=push_cnt)
+
+        from ..data.prefetch import prefetch
+        from ..ops.batch import pack_batch
+        pending: list = []  # device scalars fetched lazily at the end
+        for kind, blk, payload in prefetch(produce(), depth=3):
+            if kind == "ready":
+                layout = payload[0]
+                if layout == "panel":
+                    _, i32, f32, binary, b_cap, width, u_cap = payload
+                    i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
+                    if job_type == K_TRAINING:
+                        self.store.state, objv, auc = \
+                            self._packed_panel_train(
+                                self.store.state, i32, f32, b_cap, width,
+                                u_cap, push_cnt, binary)
+                    else:
+                        pred, objv, auc = self._packed_panel_eval(
+                            self.store.state, i32, f32, b_cap, width,
+                            u_cap, binary)
+                else:
+                    _, i32, f32, binary, b_cap, nnz_cap, u_cap = payload
+                    i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
+                    if job_type == K_TRAINING:
+                        self.store.state, objv, auc = self._packed_train(
+                            self.store.state, i32, f32, b_cap, nnz_cap,
+                            u_cap, push_cnt, binary)
+                    else:
+                        pred, objv, auc = self._packed_eval(
+                            self.store.state, i32, f32, b_cap, nnz_cap,
+                            u_cap, binary)
+                if job_type == K_PREDICTION and p.pred_out:
+                    self._save_pred(np.asarray(pred)[:blk.size], blk.label)
+                pending.append((blk.size, objv, auc))
+                continue
+
+            cblk, uniq, cnts = payload
             slots_np, remap, cnts = self.store.map_keys_dedup(uniq, cnts)
             if remap is not None:
-                # hashed-mode in-batch collisions: point the COO entries at
-                # the deduped slot rows so colliding features alias (their
-                # gradients segment-sum together on device)
+                # in-batch slot collisions / unsorted slots: point the COO
+                # entries at the deduped sorted rows so colliding features
+                # alias (their gradients segment-sum together on device)
                 cblk = dataclasses.replace(
                     cblk, index=remap[cblk.index].astype(np.uint32))
             n_uniq = len(slots_np)

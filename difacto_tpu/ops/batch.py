@@ -91,29 +91,28 @@ def panel_width(blk: RowBlock, batch_cap: int) -> Optional[int]:
     return None
 
 
-def pad_panel(blk: RowBlock, num_uniq: int, batch_cap: int, width: int
-              ) -> PanelBatch:
-    """Pack a *localized* row block into a PanelBatch."""
+def _panel_arrays(blk: RowBlock, batch_cap: int, width: int):
+    """Host-side panel arrays: (idx[B,F], vals[B,F] or None, labels,
+    rweight, row_mask)."""
     b = blk.size
     counts = np.diff(blk.offset).astype(np.int64)
     if counts.size and counts.max() > width:
         raise ValueError(f"row nnz {counts.max()} exceeds panel width "
                          f"{width}")
-    idx = np.zeros((batch_cap, width), dtype=np.int32)
     uniform = counts.size and (counts == width).all()
     if uniform and b == batch_cap:
-        idx[:] = blk.index.reshape(b, width)
+        idx = blk.index.reshape(b, width).astype(np.int32)
         vals = (None if blk.value is None
                 else blk.value.reshape(b, width).astype(REAL_DTYPE))
     else:
-        vals_np = np.zeros((batch_cap, width), dtype=REAL_DTYPE)
+        idx = np.zeros((batch_cap, width), dtype=np.int32)
+        vals = np.zeros((batch_cap, width), dtype=REAL_DTYPE)
         starts = np.asarray(blk.offset[:-1], dtype=np.int64)
         cell = (np.arange(blk.nnz, dtype=np.int64)
                 - np.repeat(starts - blk.offset[0], counts))
         rows_coo = np.repeat(np.arange(b, dtype=np.int64), counts)
         idx[rows_coo, cell] = blk.index.astype(np.int32)
-        vals_np[rows_coo, cell] = blk.values_or_ones()
-        vals = vals_np
+        vals[rows_coo, cell] = blk.values_or_ones()
 
     labels = np.zeros(batch_cap, dtype=REAL_DTYPE)
     labels[:b] = blk.label
@@ -121,14 +120,83 @@ def pad_panel(blk: RowBlock, num_uniq: int, batch_cap: int, width: int
     rweight[:b] = blk.weight if blk.weight is not None else 1.0
     row_mask = np.zeros(batch_cap, dtype=REAL_DTYPE)
     row_mask[:b] = 1.0
+    return idx, vals, labels, rweight, row_mask
+
+
+def pad_panel(blk: RowBlock, num_uniq: int, batch_cap: int, width: int
+              ) -> PanelBatch:
+    """Pack a *localized* row block into a PanelBatch."""
+    idx, vals, labels, rweight, row_mask = _panel_arrays(blk, batch_cap,
+                                                         width)
     return PanelBatch(
         idx=jnp.asarray(idx),
         vals=None if vals is None else jnp.asarray(vals),
         labels=jnp.asarray(labels), rweight=jnp.asarray(rweight),
         row_mask=jnp.asarray(row_mask),
-        num_rows=jnp.asarray(b, dtype=jnp.int32),
+        num_rows=jnp.asarray(blk.size, dtype=jnp.int32),
         num_uniq=jnp.asarray(num_uniq, dtype=jnp.int32),
     )
+
+
+def pack_panel(blk: RowBlock, num_uniq: int, slots: np.ndarray,
+               batch_cap: int, width: int, u_cap: int,
+               counts: Optional[np.ndarray] = None):
+    """Panel equivalent of pack_batch: TWO host buffers per batch.
+
+    i32 = [idx(B*F) | slots(u_cap, pre-padded via pad_slots_oob) | b, nu];
+    f32 = [vals(B*F)? | labels(B) | rweight(B) | row_mask(B) | counts(u)?].
+    """
+    if len(slots) != u_cap:
+        raise ValueError(f"slots must arrive pre-padded to u_cap={u_cap}")
+    idx, vals, labels, rweight, row_mask = _panel_arrays(blk, batch_cap,
+                                                         width)
+    binary = vals is None
+    cells = batch_cap * width
+    i32 = np.empty(cells + u_cap + 2, dtype=np.int32)
+    i32[:cells] = idx.reshape(-1)
+    i32[cells:cells + u_cap] = slots
+    i32[cells + u_cap:] = (blk.size, num_uniq)
+    vals_n = 0 if binary else cells
+    nf32 = vals_n + 3 * batch_cap + (u_cap if counts is not None else 0)
+    f32 = np.zeros(max(nf32, 1), dtype=REAL_DTYPE)
+    o = 0
+    if not binary:
+        f32[:cells] = vals.reshape(-1)
+        o = cells
+    f32[o:o + batch_cap] = labels
+    o += batch_cap
+    f32[o:o + batch_cap] = rweight
+    o += batch_cap
+    f32[o:o + batch_cap] = row_mask
+    o += batch_cap
+    if counts is not None:
+        f32[o:o + len(counts)] = counts
+    return i32, f32, binary
+
+
+def unpack_panel(i32, f32, batch_cap: int, width: int, u_cap: int,
+                 has_counts: bool = False, binary: bool = False):
+    """jit-traceable inverse of pack_panel ->
+    (PanelBatch, slots, counts-or-None)."""
+    cells = batch_cap * width
+    idx = i32[:cells].reshape(batch_cap, width)
+    slots = i32[cells:cells + u_cap]
+    meta = i32[cells + u_cap:]
+    o = 0
+    vals = None
+    if not binary:
+        vals = f32[:cells].reshape(batch_cap, width)
+        o = cells
+    labels = f32[o:o + batch_cap]
+    o += batch_cap
+    rweight = f32[o:o + batch_cap]
+    o += batch_cap
+    row_mask = f32[o:o + batch_cap]
+    o += batch_cap
+    counts = f32[o:o + u_cap] if has_counts else None
+    pb = PanelBatch(idx=idx, vals=vals, labels=labels, rweight=rweight,
+                    row_mask=row_mask, num_rows=meta[0], num_uniq=meta[1])
+    return pb, slots, counts
 
 
 def bucket(n: int, minimum: int = 8) -> int:
