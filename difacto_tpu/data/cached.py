@@ -1,0 +1,111 @@
+"""Pre-localized batch iteration over a `.rec` cache.
+
+The fast-path analog of the reference's CRB flow (src/reader/crb_parser.h:
+16-47 + the rec cache produced by task=convert, src/reader/converter.h:41-124):
+members store *compacted* CSR (uint32 positions into a sorted reversed-id
+``uniq`` vector, rec.py), so per-epoch host work skips parsing and the
+O(nnz log nnz) sort/unique of Localizer::Compact entirely — each batch costs
+an O(uniq) slot map plus buffer packing.
+
+Batches never span members (each member has its own uniq space); the
+converter aligns member row counts to the training batch size so this only
+shortens the tail batch — the same behavior as the reference's per-part
+batch boundaries (batch_reader.cc:29-69).
+
+Shuffle here is member-order + within-member row permutation (seeded per
+epoch), the cache-granular analog of the reference's shuffle buffer
+(batch_reader.cc:18-27); negative downsampling keeps the reference's exact
+arithmetic (batch_reader.cc:58-64).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .rec import read_rec_block_ex, rec_members
+from .reader import expand_uri
+from .rowblock import RowBlock, RowBlockBuilder
+
+
+def cache_is_localized(uri: str) -> bool:
+    """True if the first member of the cache carries the ``uniq`` array."""
+    files, sizes = expand_uri(uri, with_sizes=True)
+    pairs = rec_members(files, sizes)
+    if not pairs:
+        return False
+    _, uniq = read_rec_block_ex(pairs[0][0])
+    return uniq is not None
+
+
+class CachedBatchReader:
+    """Yields ``(localized_block, uniq, counts)`` triples per batch.
+
+    ``uniq`` holds the member's sorted reversed feature ids; the block's
+    ``index`` is uint32 positions into it. ``counts`` (when requested) are
+    per-uniq occurrence counts over the batch's rows — the epoch-0
+    kFeaCount payload.
+    """
+
+    def __init__(self, uri: str, part_idx: int = 0, num_parts: int = 1,
+                 batch_size: int = 100, shuffle: bool = False,
+                 neg_sampling: float = 1.0, seed: int = 0,
+                 need_counts: bool = False):
+        files, sizes = expand_uri(uri, with_sizes=True)
+        self._pairs = rec_members(files, sizes)
+        if not self._pairs:
+            raise FileNotFoundError(f"empty rec cache: {uri!r}")
+        # member sharding by cumulative compressed size (rec.py
+        # iter_rec_blocks): a member belongs to the part holding its start
+        total = sum(sz for _, sz in self._pairs)
+        begin = total * part_idx // num_parts
+        end = total * (part_idx + 1) // num_parts
+        self._members: List[str] = []
+        base = 0
+        for m, sz in self._pairs:
+            if begin <= base < end:
+                self._members.append(m)
+            base += sz
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.neg_sampling = neg_sampling
+        self.seed = seed
+        self.need_counts = need_counts
+
+    def __iter__(self) -> Iterator[Tuple[RowBlock, np.ndarray,
+                                         Optional[np.ndarray]]]:
+        rng = np.random.RandomState(self.seed)
+        order = np.arange(len(self._members))
+        if self.shuffle:
+            rng.shuffle(order)
+        for mi in order:
+            blk, uniq = read_rec_block_ex(self._members[mi])
+            if uniq is None:
+                raise ValueError(
+                    f"cache member {self._members[mi]!r} is not "
+                    "pre-localized; re-convert with rec_localize=1")
+            rows = np.arange(blk.size)
+            if self.shuffle:
+                rng.shuffle(rows)
+            if self.neg_sampling < 1.0:
+                # keep a negative iff p <= 1 - neg_sampling
+                # (batch_reader.cc:58-64)
+                keep = (blk.label[rows] > 0) | (
+                    rng.random_sample(len(rows)) <= 1.0 - self.neg_sampling)
+                rows = rows[keep]
+            whole = (len(rows) == blk.size and blk.size <= self.batch_size
+                     and not self.shuffle)
+            for s in range(0, len(rows), self.batch_size):
+                if whole:
+                    sub = blk
+                else:
+                    b = RowBlockBuilder()
+                    b.push_rows(blk, rows[s:s + self.batch_size])
+                    sub = b.build()
+                counts = None
+                if self.need_counts:
+                    counts = np.bincount(
+                        sub.index.astype(np.int64),
+                        minlength=len(uniq)).astype(np.float32)
+                yield sub, uniq, counts

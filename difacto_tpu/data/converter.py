@@ -5,20 +5,32 @@ with the same parameters: data_in/data_format -> data_out/data_out_format,
 ``chunk_size`` MB read granularity, optional ``part_size`` MB output splitting
 (-1 = single output). The rec output is the npz-shard cache of rec.py — the
 fast binary path that keeps TPU chips fed (SURVEY §7 hard part (e)).
+
+Two rec upgrades over the reference's CRB converter:
+
+- ``rec_localize`` (default on) stores members *pre-localized* (compacted
+  uint32 index + sorted reversed-id ``uniq``, like CRB's compacted CSR,
+  src/reader/crb_parser.h:16-47) so training epochs skip parse + unique;
+- ``rec_batch_size`` aligns member row counts to the training batch size so
+  cached batches never straddle members, and ``convert_threads`` text
+  chunks are parsed/localized/compressed in parallel (the dmlc
+  ThreadedParser role, src/reader/reader.h:42-44).
 """
 
 from __future__ import annotations
 
 import logging
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 
 import numpy as np
 
 from ..config import KWArgs, Param
 from ..utils import stream
+from .localizer import compact
 from .reader import Reader
 from .rec import write_rec_block
-from .rowblock import RowBlock
+from .rowblock import RowBlock, RowBlockBuilder
 
 log = logging.getLogger("difacto_tpu")
 
@@ -31,6 +43,9 @@ class ConverterParam(Param):
     data_out_format: str = ""
     part_size: int = -1      # MB per output part; -1 = one output
     chunk_size: float = 512  # MB per read chunk
+    rec_localize: bool = True
+    rec_batch_size: int = 0  # rows per rec member; 0 = one member per chunk
+    convert_threads: int = 0  # 0 = auto
 
 
 class Converter:
@@ -48,6 +63,127 @@ class Converter:
         return remain
 
     def run(self) -> None:
+        if self.param.data_out_format == "rec":
+            self._run_rec()
+        else:
+            self._run_libsvm()
+
+    # ------------------------------------------------------------- rec
+    def _parsed_blocks(self, threads: int):
+        """Parse text chunks on ``threads`` workers, yielding blocks in
+        read order (the dmlc ThreadedParser role; native parsers and numpy
+        release the GIL, so threads scale)."""
+        from collections import deque
+
+        p = self.param
+        if p.data_format.lower() == "rec":
+            yield from Reader(p.data_in, p.data_format, 0, 1,
+                              chunk_bytes=int(p.chunk_size * (1 << 20)))
+            return
+        from .parsers import get_parser
+        from .reader import _byte_ranges, _iter_text_chunks, expand_uri
+        parse = get_parser(p.data_format)
+        files, sizes = expand_uri(p.data_in, with_sizes=True)
+        # read granularity small enough to keep every worker busy
+        chunk_bytes = min(int(p.chunk_size * (1 << 20)), 32 << 20)
+
+        def chunks():
+            for path, b, e in _byte_ranges(files, sizes, 0, 1):
+                yield from _iter_text_chunks(path, b, e, chunk_bytes)
+
+        with ThreadPoolExecutor(max_workers=threads) as ex:
+            futs: deque = deque()
+            for ch in chunks():
+                futs.append(ex.submit(parse, ch))
+                while len(futs) >= 2 * threads:
+                    blk = futs.popleft().result()
+                    if blk.size:
+                        yield blk
+            while futs:
+                blk = futs.popleft().result()
+                if blk.size:
+                    yield blk
+
+    def _run_rec(self) -> None:
+        """Parallel pipeline: threaded parse -> row-aligned member slicing
+        -> threaded (localize + compress + write)."""
+        import os
+        p = self.param
+        log.info("reading data from %s in %s format", p.data_in,
+                 p.data_format)
+        threads = p.convert_threads or min(6, os.cpu_count() or 1)
+        split = p.part_size > 0
+        limit = p.part_size * (1 << 20) if split else None
+
+        nrows = 0
+        ipart = 0
+        nblk = 0
+        written = [0]  # compressed bytes in current part (approximate:
+        # updated as write futures land; part rollover is checked between
+        # member submissions)
+        out_dir = self._open_rec_part(ipart, split)
+
+        def write_member(path: str, blk: RowBlock) -> int:
+            if p.rec_localize:
+                cblk, uniq, _ = compact(blk)
+                write_rec_block(path, cblk, uniq=uniq)
+            else:
+                write_rec_block(path, blk)
+            sz = stream.getsize(path)
+            written[0] += sz
+            return sz
+
+        def member_blocks(blocks):
+            """Re-slice parsed blocks into rec_batch_size-row members,
+            carrying remainders across blocks (batches never straddle
+            members, data/cached.py)."""
+            if not p.rec_batch_size:
+                yield from blocks
+                return
+            bs = p.rec_batch_size
+            builder = RowBlockBuilder()
+            for blk in blocks:
+                start = 0
+                while start < blk.size:
+                    take = min(bs - builder.num_rows, blk.size - start)
+                    builder.push(blk.slice(start, start + take))
+                    start += take
+                    if builder.num_rows >= bs:
+                        yield builder.build()
+                        builder.clear()
+            if builder.num_rows:
+                yield builder.build()
+
+        futures = []
+        with ThreadPoolExecutor(max_workers=threads) as ex:
+            for blk in member_blocks(self._parsed_blocks(threads)):
+                if split and written[0] >= limit:
+                    for f in futures:  # part boundary: settle sizes
+                        f.result()
+                    futures.clear()
+                    ipart += 1
+                    nblk = 0
+                    written[0] = 0
+                    out_dir = self._open_rec_part(ipart, split)
+                path = stream.join(out_dir, f"part-{nblk:05d}.npz")
+                futures.append(ex.submit(write_member, path, blk))
+                nblk += 1
+                nrows += blk.size
+                if len(futures) >= 2 * threads:
+                    futures.pop(0).result()
+            for f in futures:
+                f.result()
+        log.info("done. written %d examples", nrows)
+        self.num_rows = nrows
+
+    def _open_rec_part(self, ipart: int, split: bool) -> str:
+        path = self.param.data_out + (f"-part_{ipart}" if split else "")
+        stream.makedirs(path)
+        log.info("writing data to %s in rec format", path)
+        return path
+
+    # ------------------------------------------------------------- libsvm
+    def _run_libsvm(self) -> None:
         p = self.param
         reader = Reader(p.data_in, p.data_format, 0, 1,
                         chunk_bytes=int(p.chunk_size * (1 << 20)))
@@ -65,47 +201,34 @@ class Converter:
             path = p.data_out + (f"-part_{ipart}" if split else "")
             ipart += 1
             nwrite = 0
-            if p.data_out_format == "libsvm":
-                out = stream.open_stream(path, "w")
-            else:
-                stream.makedirs(path)
-                out = path  # rec: a directory of npz members
-            log.info("writing data to %s in %s format", path,
-                     p.data_out_format)
+            out = stream.open_stream(path, "w")
+            log.info("writing data to %s in libsvm format", path)
             return out
 
         out = open_part()
-        nblk = 0
         for blk in reader:
             if split and nwrite >= limit:
-                if p.data_out_format == "libsvm":
-                    out.close()
+                out.close()
                 out = open_part()
-                nblk = 0
-            nwrite += self._write_block(out, blk, nblk)
-            nblk += 1
+            nwrite += self._write_text_block(out, blk)
             nrows += blk.size
-        if p.data_out_format == "libsvm" and out is not None:
+        if out is not None:
             out.close()
         log.info("done. written %d examples", nrows)
         self.num_rows = nrows
 
-    def _write_block(self, out, blk: RowBlock, nblk: int) -> int:
-        if self.param.data_out_format == "libsvm":
-            # vectorised token formatting; only the per-row join is Python
-            idx = np.char.mod("%d", blk.index.astype(np.uint64))
-            if blk.value is not None:
-                feats = np.char.add(np.char.add(idx, ":"),
-                                    np.char.mod("%g", blk.value))
-            else:
-                feats = np.char.add(idx, ":1")
-            labels = np.char.mod("%g", blk.label)
-            off = blk.offset
-            lines = [labels[i] + " " + " ".join(feats[off[i]:off[i + 1]])
-                     for i in range(blk.size)]
-            data = "\n".join(lines) + "\n"
-            out.write(data)
-            return len(data)
-        path = stream.join(out, f"part-{nblk:05d}.npz")
-        write_rec_block(path, blk)
-        return stream.getsize(path)
+    def _write_text_block(self, out, blk: RowBlock) -> int:
+        # vectorised token formatting; only the per-row join is Python
+        idx = np.char.mod("%d", blk.index.astype(np.uint64))
+        if blk.value is not None:
+            feats = np.char.add(np.char.add(idx, ":"),
+                                np.char.mod("%g", blk.value))
+        else:
+            feats = np.char.add(idx, ":1")
+        labels = np.char.mod("%g", blk.label)
+        off = blk.offset
+        lines = [labels[i] + " " + " ".join(feats[off[i]:off[i + 1]])
+                 for i in range(blk.size)]
+        data = "\n".join(lines) + "\n"
+        out.write(data)
+        return len(data)

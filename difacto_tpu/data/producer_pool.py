@@ -1,0 +1,117 @@
+"""Ordered multi-worker batch production over a WorkloadPool.
+
+The single-host analog of the reference's pull-based worker self-scheduling
+(src/tracker/dist_tracker.h:136-156 RespHandle hands a finishing node its
+next part from the WorkloadPool): N producer threads request file parts from
+a shared :class:`tracker.workload_pool.WorkloadPool`, run the host pipeline
+(read -> localize -> slot-map -> pack) for their part, and push prepared
+batches into per-part bounded queues. The consumer (the learner's dispatch
+loop) drains parts in canonical order, so training trajectories stay
+deterministic regardless of worker count or scheduling — the TPU-first trade
+replacing the reference's nondeterministic async dispatch.
+
+Memory is bounded: each part queue holds <= depth items and a worker blocks
+once its queue fills, so at most (workers + completed-but-unconsumed parts)
+x depth batches are in flight.
+
+A worker that raises re-queues its part via ``pool.reset`` (the dead-node
+path, workload_pool.h:88-105) so another worker can retry it; the retry
+skips the items the failed attempt already enqueued (part iteration is
+deterministic), and the error is re-raised to the consumer only if the part
+keeps failing (max_retries).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+from ..tracker.workload_pool import WorkloadPool, WorkloadPoolParam
+
+_END = object()
+
+
+class OrderedProducerPool:
+    """Iterate items of ``make_iter(part)`` for part 0..n_parts-1, in order,
+    produced by ``n_workers`` background threads."""
+
+    def __init__(self, n_parts: int, make_iter: Callable[[int], Iterator],
+                 n_workers: int = 2, depth: int = 4,
+                 pool: Optional[WorkloadPool] = None, max_retries: int = 1):
+        self.n_parts = n_parts
+        self.make_iter = make_iter
+        self.n_workers = max(1, min(n_workers, n_parts))
+        self.depth = depth
+        self.pool = pool or WorkloadPool(WorkloadPoolParam())
+        self.pool.clear()
+        self.pool.add(n_parts)
+        self.max_retries = max_retries
+        self._queues = [queue.Queue(maxsize=depth) for _ in range(n_parts)]
+        self._stop = threading.Event()
+        self._errors: list = []
+        self._fail_counts = [0] * n_parts
+        self._enqueued = [0] * n_parts  # items already delivered per part
+        self._threads = [
+            threading.Thread(target=self._work, args=(w,), daemon=True)
+            for w in range(self.n_workers)
+        ]
+
+    def _put(self, part: int, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._queues[part].put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _work(self, node: int) -> None:
+        while not self._stop.is_set():
+            part = self.pool.get(node)
+            if part == -2:
+                if self.pool.num_remains() == 0:
+                    return
+                time.sleep(0.02)  # a failed part may be re-queued
+                continue
+            try:
+                # a retry resumes after the items the failed attempt already
+                # enqueued (deterministic per-part iteration)
+                it = itertools.islice(self.make_iter(part),
+                                      self._enqueued[part], None)
+                for item in it:
+                    if not self._put(part, item):
+                        self.pool.reset(node)
+                        return
+                    self._enqueued[part] += 1
+                if not self._put(part, _END):
+                    self.pool.reset(node)
+                    return
+                self.pool.finish(node)
+            except BaseException as e:  # re-queue, escalate if persistent
+                self._fail_counts[part] += 1
+                if self._fail_counts[part] > self.max_retries:
+                    self._errors.append(e)
+                    self._put(part, _END)
+                    self.pool.finish(node)
+                else:
+                    self.pool.reset(node)
+
+    def __iter__(self) -> Iterator:
+        for t in self._threads:
+            t.start()
+        try:
+            for part in range(self.n_parts):
+                while True:
+                    item = self._queues[part].get()
+                    if item is _END:
+                        break
+                    yield part, item
+                if self._errors:
+                    raise self._errors[0]
+        finally:
+            self._stop.set()
+            for t in self._threads:
+                t.join()

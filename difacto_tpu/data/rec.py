@@ -10,11 +10,18 @@ Format: a ``<name>.rec`` directory (or explicit file list) of ``.npz``
 members, one compressed CSR block each, arrays: offset/label/index[/value]
 [/weight]. Sharding for (part_idx, num_parts) is by whole members, weighted
 by compressed size — the unit of work-stealing, like recordio parts.
+
+**Pre-localized members** additionally carry ``uniq``: the member's sorted
+distinct *reversed* feature ids (the Localizer output, data/localizer.py),
+with ``index`` already remapped to uint32 positions into it — the same trick
+as the reference's CRB storing compacted CSR (crb_parser.h:16-47). Epochs
+then skip parse + the O(nnz) sort/unique entirely; the per-batch host work
+collapses to an O(uniq) slot map + buffer packing.
 """
 
 from __future__ import annotations
 
-from typing import Iterator, List
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -22,8 +29,14 @@ from ..utils import stream
 from .rowblock import RowBlock
 
 
-def write_rec_block(path: str, blk: RowBlock, compress: bool = True) -> None:
+def write_rec_block(path: str, blk: RowBlock, compress: bool = True,
+                    uniq: Optional[np.ndarray] = None) -> None:
+    """``uniq`` marks a pre-localized member: blk.index must be uint32
+    positions into uniq (sorted reversed ids)."""
     arrays = dict(offset=blk.offset, label=blk.label, index=blk.index)
+    if uniq is not None:
+        arrays["uniq"] = uniq
+        arrays["index"] = blk.index.astype(np.uint32)
     if blk.value is not None:
         arrays["value"] = blk.value
     if blk.weight is not None:
@@ -31,15 +44,28 @@ def write_rec_block(path: str, blk: RowBlock, compress: bool = True) -> None:
     stream.save_npz(path, compress=compress, **arrays)
 
 
-def read_rec_block(path: str) -> RowBlock:
+def read_rec_block_ex(path: str) -> Tuple[RowBlock, Optional[np.ndarray]]:
+    """(block, uniq-or-None); uniq != None means index is localized."""
     with stream.load_npz(path) as z:
-        return RowBlock(
+        blk = RowBlock(
             offset=z["offset"],
             label=z["label"],
             index=z["index"],
             value=z["value"] if "value" in z.files else None,
             weight=z["weight"] if "weight" in z.files else None,
         )
+        return blk, (z["uniq"] if "uniq" in z.files else None)
+
+
+def read_rec_block(path: str) -> RowBlock:
+    """Legacy view: localized members are de-localized back to the ORIGINAL
+    id space (uniq holds reversed ids; un-reverse on expansion) so
+    format-agnostic callers see ordinary uint64 CSR blocks."""
+    from ..base import reverse_bytes
+    blk, uniq = read_rec_block_ex(path)
+    if uniq is not None:
+        blk.index = reverse_bytes(uniq)[blk.index]
+    return blk
 
 
 def rec_members(files: List[str], sizes=None) -> List[tuple]:

@@ -67,6 +67,17 @@ class SGDLearnerParam(Param):
     stop_val_auc: float = 1e-5
     has_aux: bool = False
     task: int = 0  # 0 = train, 2 = predict (main.cc task names train/predict)
+    # host pipeline: producer threads preparing batches ahead of the device
+    # (the reference's ThreadedParser + 3-thread worker pipeline,
+    # sgd_learner.h:85-102); 0 = auto. Parts are dispatched to producers
+    # through the WorkloadPool (pull-based self-scheduling,
+    # dist_tracker.h:136-156) and consumed in canonical order, so
+    # trajectories stay deterministic.
+    num_producers: int = 0
+    producer_depth: int = 3
+    # per-step training metric: "binned" = O(B) histogram AUC (default),
+    # "exact" = argsort AUC, "none". Validation is always exact (step.py).
+    train_auc: str = "binned"
     # SPMD mesh (parallel/mesh.py): feature shards ("servers") × data
     # parallelism ("workers"); 1×1 = single device. The reference analog is
     # launch.py's -s/-n server/worker counts.
@@ -124,7 +135,8 @@ class SGDLearner(Learner):
                         f"of the host count {self._num_hosts}")
                 # dp-sharded dims must divide the dp axis (see dim_min in
                 # _iterate_data)
-                dmin = max(8, 2 * self.param.mesh_dp)
+                from ..ops.batch import mesh_dim_min
+                dmin = mesh_dim_min(self.param.mesh_dp)
                 auto = bucket(self.param.batch_size * 64, dmin)
                 self._spmd_b_cap = bucket(self.param.batch_size, dmin)
                 self._spmd_nnz_cap = self.param.nnz_cap or auto
@@ -145,7 +157,8 @@ class SGDLearner(Learner):
         from ..ops.batch import unpack_batch
         from ..step import make_step_fns
         fns = self.store.fns
-        _, train_step, eval_step = make_step_fns(fns, self.loss)
+        _, train_step, eval_step = make_step_fns(
+            fns, self.loss, train_auc=self.param.train_auc)
         self._train_step = jax.jit(train_step, donate_argnums=0)
         self._eval_step = jax.jit(eval_step)
         self._apply_count = jax.jit(fns.apply_count, donate_argnums=0)
@@ -155,43 +168,47 @@ class SGDLearner(Learner):
         # remote devices per-transfer latency dominates the host->device
         # path, so 2 transfers/batch instead of 8
         def packed_train(state, i32, f32, b_cap, nnz_cap, u_cap, has_cnt,
-                         binary):
+                         binary, has_remap=False):
             batch, slots, counts = unpack_batch(i32, f32, b_cap, nnz_cap,
-                                                u_cap, has_cnt, binary)
+                                                u_cap, has_cnt, binary,
+                                                has_remap)
             if counts is not None:
                 state = fns.apply_count(state, slots, counts)
             return train_step(state, batch, slots)
 
-        def packed_eval(state, i32, f32, b_cap, nnz_cap, u_cap, binary):
+        def packed_eval(state, i32, f32, b_cap, nnz_cap, u_cap, binary,
+                        has_remap=False):
             batch, slots, _ = unpack_batch(i32, f32, b_cap, nnz_cap, u_cap,
-                                           binary=binary)
+                                           binary=binary,
+                                           has_remap=has_remap)
             return eval_step(state, batch, slots)
 
         self._packed_train = jax.jit(packed_train, donate_argnums=0,
-                                     static_argnums=(3, 4, 5, 6, 7))
+                                     static_argnums=(3, 4, 5, 6, 7, 8))
         self._packed_eval = jax.jit(packed_eval,
-                                    static_argnums=(3, 4, 5, 6))
+                                    static_argnums=(3, 4, 5, 6, 7))
 
         from ..ops.batch import unpack_panel
 
         def packed_panel_train(state, i32, f32, b_cap, width, u_cap,
-                               has_cnt, binary):
+                               has_cnt, binary, has_remap=False):
             pb, slots, counts = unpack_panel(i32, f32, b_cap, width, u_cap,
-                                             has_cnt, binary)
+                                             has_cnt, binary, has_remap)
             if counts is not None:
                 state = fns.apply_count(state, slots, counts)
             return train_step(state, pb, slots)
 
-        def packed_panel_eval(state, i32, f32, b_cap, width, u_cap, binary):
+        def packed_panel_eval(state, i32, f32, b_cap, width, u_cap, binary,
+                              has_remap=False):
             pb, slots, _ = unpack_panel(i32, f32, b_cap, width, u_cap,
-                                        binary=binary)
+                                        binary=binary, has_remap=has_remap)
             return eval_step(state, pb, slots)
 
         self._packed_panel_train = jax.jit(packed_panel_train,
                                            donate_argnums=0,
-                                           static_argnums=(3, 4, 5, 6, 7))
+                                           static_argnums=(3, 4, 5, 6, 7, 8))
         self._packed_panel_eval = jax.jit(packed_panel_eval,
-                                          static_argnums=(3, 4, 5, 6))
+                                          static_argnums=(3, 4, 5, 6, 7))
 
     # ----------------------------------------------------------- driver
     def run(self) -> None:
@@ -275,19 +292,27 @@ class SGDLearner(Learner):
     def _run_epoch(self, epoch: int, job_type: int, prog: Progress) -> None:
         p = self.param
         n_jobs = p.num_jobs_per_epoch if job_type == K_TRAINING else 1
-        for part in range(n_jobs):
-            before = Progress(nrows=prog.nrows, loss=prog.loss, auc=prog.auc)
-            self._iterate_data(job_type, epoch, part, n_jobs, prog)
-            if job_type == K_TRAINING and p.report_interval > 0:
-                # report only this part's delta, like the reference's
-                # per-batch reporter messages (sgd_learner.cc:242-247)
-                elapsed = time.time() - self._start_time
-                self._report.prog.merge(Progress(
-                    nrows=prog.nrows - before.nrows,
-                    loss=prog.loss - before.loss,
-                    auc=prog.auc - before.auc))
-                print(f"{elapsed:5.0f}  {self._report.print_str()}",
-                      flush=True)
+        if self._num_hosts > 1 and self.mesh is not None:
+            for part in range(n_jobs):
+                before = Progress(nrows=prog.nrows, loss=prog.loss,
+                                  auc=prog.auc)
+                self._iterate_data_spmd(job_type, epoch, part, n_jobs, prog)
+                self._report_part(job_type, before, prog)
+            return
+        self._iterate_parts(job_type, epoch, n_jobs, prog)
+
+    def _report_part(self, job_type: int, before: Progress, prog: Progress
+                     ) -> None:
+        """Throttled progress row after a part, like the reference's
+        per-batch reporter messages (sgd_learner.cc:242-247)."""
+        if job_type != K_TRAINING or self.param.report_interval <= 0:
+            return
+        elapsed = time.time() - self._start_time
+        self._report.prog.merge(Progress(
+            nrows=prog.nrows - before.nrows,
+            loss=prog.loss - before.loss,
+            auc=prog.auc - before.auc))
+        print(f"{elapsed:5.0f}  {self._report.print_str()}", flush=True)
 
     def _make_reader(self, job_type: int, epoch: int, g_idx: int,
                      g_num: int):
@@ -335,7 +360,23 @@ class SGDLearner(Learner):
 
         def produce():
             for blk in reader:
-                yield blk, compact(blk, need_counts=push_cnt)
+                if job_type == K_TRAINING:
+                    yield blk, compact(blk, need_counts=push_cnt)
+                    continue
+                # eval/pred reads arrive as 256MB Reader chunks; the SPMD
+                # shape schedule pins b_cap to bucket(batch_size), so slice
+                # into row windows that fit BOTH the row and nnz caps
+                # before the synchronized steps (uniq <= nnz <= nnz_cap)
+                s = 0
+                while s < blk.size:
+                    e = min(s + p.batch_size, blk.size)
+                    lim = blk.offset[s] + min(nnz_cap, u_cap)
+                    e_nnz = int(np.searchsorted(blk.offset, lim,
+                                                side="right")) - 1
+                    e = max(min(e, e_nnz), s + 1)
+                    sub = blk.slice(s, e)
+                    s = e
+                    yield sub, compact(sub, need_counts=False)
 
         from ..data.prefetch import prefetch
         it = iter(prefetch(produce(), depth=2))
@@ -359,7 +400,9 @@ class SGDLearner(Learner):
                         f"batch (rows={blk.size}, nnz={blk.nnz}, uniq={nu}) "
                         f"exceeds the multi-host shape schedule (b_cap="
                         f"{b_cap}, nnz_cap={nnz_cap}, uniq_cap={u_cap}); "
-                        "raise nnz_cap/uniq_cap in the config")
+                        "raise nnz_cap/uniq_cap in the config (b_cap "
+                        "follows batch_size — raise batch_size if rows "
+                        "exceed it)")
                 payload[:nu] = slots_np
                 if push_cnt and cnts is not None:
                     payload[u_cap:u_cap + nu] = cnts.astype(np.int64)
@@ -442,12 +485,15 @@ class SGDLearner(Learner):
             prog.merge(Progress(nrows=nrows, loss=float(np.asarray(objv)),
                                 auc=float(np.asarray(auc))))
 
-    def _prepare_hashed(self, blk, push_cnt: bool, dim_min: int):
+    def _prepare_hashed(self, blk, push_cnt: bool, dim_min: int,
+                        b_cap: Optional[int] = None):
         """Producer-thread batch preparation for the hashed store: ONE
         int32 np.unique collapses localization (Localizer::Compact),
         key->slot mapping, and collision dedup, then the batch packs into
         the two-buffer transfer — panel layout when rows are near-uniform
-        (criteo), COO otherwise. Stateless, so safe off-thread."""
+        (criteo), COO otherwise. Stateless, so safe off-thread. ``b_cap``
+        pins the row cap (the training shape schedule; short tails pad up
+        so epochs never recompile)."""
         from ..base import reverse_bytes
         from ..ops.batch import pack_panel, panel_width
         from ..store.local import pad_slots_oob
@@ -465,7 +511,7 @@ class SGDLearner(Learner):
         cblk = dataclasses.replace(blk, index=inverse.astype(np.uint32))
         n_uniq = len(slots)
         u_cap = bucket(n_uniq)
-        b_cap = bucket(blk.size, dim_min)
+        b_cap = b_cap or bucket(blk.size, dim_min)
         padded = pad_slots_oob(slots.astype(np.int32), u_cap,
                                self.store.param.hash_capacity)
         width = panel_width(cblk, b_cap)
@@ -473,143 +519,239 @@ class SGDLearner(Learner):
             i32, f32, binary = pack_panel(
                 cblk, n_uniq, padded, b_cap, width, u_cap,
                 counts=counts if push_cnt else None)
-            return ("panel", i32, f32, binary, b_cap, width, u_cap)
+            return ("panel", i32, f32, binary, b_cap, width, u_cap, False)
         from ..ops.batch import pack_batch
         nnz_cap = bucket(blk.nnz, dim_min)
         i32, f32, binary = pack_batch(
             cblk, n_uniq, padded, b_cap, nnz_cap, u_cap,
             counts=counts if push_cnt else None)
-        return ("coo", i32, f32, binary, b_cap, nnz_cap, u_cap)
+        return ("coo", i32, f32, binary, b_cap, nnz_cap, u_cap, False)
 
-    def _iterate_data(self, job_type: int, epoch: int, part_idx: int,
-                      num_parts: int, prog: Progress) -> None:
-        """IterateData (sgd_learner.cc:201-317) — fused-step version."""
-        if self._num_hosts > 1 and self.mesh is not None:
-            return self._iterate_data_spmd(job_type, epoch, part_idx,
-                                           num_parts, prog)
+    def _prepare_from_uniq(self, cblk, uniq, counts, push_cnt: bool,
+                           dim_min: int, b_cap: Optional[int] = None):
+        """Cached fast path (data/cached.py): the block arrives already
+        localized to ``uniq`` (sorted reversed ids), so host work is just
+        the O(uniq) slot map + dedup; the O(nnz) index array ships
+        UNTOUCHED — in-batch hash collisions ride the packed ``remap``
+        vector and are resolved on device (step.py pull/push_grads)."""
+        from ..ops.batch import pack_panel, panel_width
+        from ..store.local import pad_slots_oob
+
+        hcap = np.uint64(self.store.param.hash_capacity - 1)
+        raw = (uniq % hcap + np.uint64(1)).astype(np.int32)
+        slots, remap = np.unique(raw, return_inverse=True)
+        n_lanes = len(uniq)
+        u_cap = bucket(n_lanes)
+        b_cap = b_cap or bucket(cblk.size, dim_min)
+        scounts = None
+        if push_cnt and counts is not None:
+            # counts are per uniq lane; aggregate to slot space (colliding
+            # lanes sum, mirroring map_keys_dedup)
+            scounts = np.zeros(u_cap, dtype=np.float32)
+            scounts[:len(slots)] = np.bincount(
+                remap, weights=counts, minlength=len(slots))
+        padded = pad_slots_oob(slots.astype(np.int32), u_cap,
+                               self.store.param.hash_capacity)
+        remap32 = remap.astype(np.int32)
+        width = panel_width(cblk, b_cap)
+        if width is not None:
+            i32, f32, binary = pack_panel(
+                cblk, n_lanes, padded, b_cap, width, u_cap,
+                counts=scounts, remap=remap32)
+            return ("panel", i32, f32, binary, b_cap, width, u_cap, True)
+        from ..ops.batch import pack_batch
+        nnz_cap = bucket(cblk.nnz, dim_min)
+        i32, f32, binary = pack_batch(
+            cblk, n_lanes, padded, b_cap, nnz_cap, u_cap,
+            counts=scounts, remap=remap32)
+        return ("coo", i32, f32, binary, b_cap, nnz_cap, u_cap, True)
+
+    def _cached_uri(self, job_type: int) -> Optional[str]:
+        """The pre-localized rec cache uri for this job, or None."""
+        p = self.param
+        if p.data_format.lower() != "rec":
+            return None
+        uri = p.data_in if job_type == K_TRAINING \
+            else (p.data_val or p.data_in)
+        if not hasattr(self, "_cache_probe"):
+            self._cache_probe = {}
+        if uri not in self._cache_probe:
+            from ..data.cached import cache_is_localized
+            try:
+                self._cache_probe[uri] = cache_is_localized(uri)
+            except FileNotFoundError:
+                self._cache_probe[uri] = False
+        return uri if self._cache_probe[uri] else None
+
+    def _merge_pending(self, pending: list, prog: Progress) -> None:
+        """Fetch all dispatched metric scalars in ONE transfer and merge —
+        JAX async dispatch supplies the pipeline overlap."""
+        if not pending:
+            return
+        flat = jnp.stack([s for _, o, a in pending for s in (o, a)])
+        vals = np.asarray(flat)
+        for i, (nrows, _, _) in enumerate(pending):
+            prog.merge(Progress(nrows=nrows, loss=float(vals[2 * i]),
+                                auc=float(vals[2 * i + 1])))
+
+    def _iterate_parts(self, job_type: int, epoch: int, n_jobs: int,
+                       prog: Progress) -> None:
+        """IterateData (sgd_learner.cc:201-317) — fused-step version over
+        all of this epoch's parts, produced by a WorkloadPool-fed thread
+        pool (data/producer_pool.py) and consumed in canonical order."""
+        import os
         p = self.param
         push_cnt = (job_type == K_TRAINING and epoch == 0
                     and self.do_embedding)
-        # this host's slice of the global part space
-        g_idx = self._host_rank * num_parts + part_idx
-        g_num = num_parts * self._num_hosts
-        reader = self._make_reader(job_type, epoch, g_idx, g_num)
-
-        # sharded batch dims must divide the dp axis: force bucket rungs
-        # whose every value is a multiple of mesh_dp (rungs >= 2*dp are
-        # {2^k, 3*2^(k-1)} with 2^(k-1) >= dp)
-        dim_min = 8 if self.mesh is None else max(8, 2 * self.param.mesh_dp)
+        from ..ops.batch import mesh_dim_min
+        dim_min = 8 if self.mesh is None else mesh_dim_min(p.mesh_dp)
         hashed_fast = self.store.hashed and self.mesh is None
+        b_cap_train = bucket(p.batch_size, dim_min)
+        cached_uri = self._cached_uri(job_type)
+        is_train = job_type == K_TRAINING
 
-        def produce():
-            # EVERYTHING host-side happens on the producer thread so it
+        def make_iter(part):
+            # EVERYTHING host-side happens on producer threads so it
             # overlaps device execution. Hashed mode is stateless (no
-            # dictionary), so localization AND packing move here; the
+            # dictionary), so localization AND packing run here; the
             # dictionary store mutates host state on insert, so only
             # parse+compact runs here and the consumer maps keys.
+            g_idx = self._host_rank * n_jobs + part
+            g_num = n_jobs * self._num_hosts
+            if cached_uri is not None:
+                from ..data.cached import CachedBatchReader
+                rdr = CachedBatchReader(
+                    cached_uri, g_idx, g_num, p.batch_size,
+                    shuffle=is_train and p.shuffle > 0,
+                    neg_sampling=p.neg_sampling if is_train else 1.0,
+                    seed=epoch * max(g_num, 1) + g_idx,
+                    need_counts=push_cnt)
+                for sub, uniq, cnts in rdr:
+                    if hashed_fast:
+                        yield ("ready", sub, self._prepare_from_uniq(
+                            sub, uniq, cnts, push_cnt, dim_min,
+                            b_cap_train if is_train else None))
+                    else:
+                        yield ("compact", sub, (sub, uniq, cnts))
+                return
+            reader = self._make_reader(job_type, epoch, g_idx, g_num)
             for blk in reader:
                 if hashed_fast:
-                    yield "ready", blk, self._prepare_hashed(blk, push_cnt,
-                                                             dim_min)
+                    yield ("ready", blk, self._prepare_hashed(
+                        blk, push_cnt, dim_min,
+                        b_cap_train if is_train else None))
                 else:
-                    yield "compact", blk, compact(blk, need_counts=push_cnt)
+                    yield ("compact", blk, compact(blk,
+                                                   need_counts=push_cnt))
 
-        from ..data.prefetch import prefetch
+        from ..data.producer_pool import OrderedProducerPool
+        n_workers = p.num_producers or max(1, min(4, os.cpu_count() or 1))
+        pool = OrderedProducerPool(n_jobs, make_iter, n_workers=n_workers,
+                                   depth=p.producer_depth)
+        pending: list = []
+        cur_part = 0
+        before = Progress(nrows=prog.nrows, loss=prog.loss, auc=prog.auc)
+        for part, item in pool:
+            if part != cur_part:
+                self._merge_pending(pending, prog)
+                pending = []
+                self._report_part(job_type, before, prog)
+                before = Progress(nrows=prog.nrows, loss=prog.loss,
+                                  auc=prog.auc)
+                cur_part = part
+            self._dispatch_item(job_type, item, push_cnt, dim_min, pending)
+        self._merge_pending(pending, prog)
+        self._report_part(job_type, before, prog)
+
+    def _dispatch_item(self, job_type: int, item, push_cnt: bool,
+                       dim_min: int, pending: list) -> None:
+        """Consume one produced batch: stage + run the fused device step."""
+        p = self.param
         from ..ops.batch import pack_batch
-        pending: list = []  # device scalars fetched lazily at the end
-        for kind, blk, payload in prefetch(produce(), depth=3):
-            if kind == "ready":
-                layout = payload[0]
-                if layout == "panel":
-                    _, i32, f32, binary, b_cap, width, u_cap = payload
-                    i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
-                    if job_type == K_TRAINING:
-                        self.store.state, objv, auc = \
-                            self._packed_panel_train(
-                                self.store.state, i32, f32, b_cap, width,
-                                u_cap, push_cnt, binary)
-                    else:
-                        pred, objv, auc = self._packed_panel_eval(
+        kind, blk, payload = item
+        if kind == "ready":
+            layout = payload[0]
+            if layout == "panel":
+                _, i32, f32, binary, b_cap, width, u_cap, has_rm = payload
+                i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
+                if job_type == K_TRAINING:
+                    self.store.state, objv, auc = \
+                        self._packed_panel_train(
                             self.store.state, i32, f32, b_cap, width,
-                            u_cap, binary)
+                            u_cap, push_cnt, binary, has_rm)
                 else:
-                    _, i32, f32, binary, b_cap, nnz_cap, u_cap = payload
-                    i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
-                    if job_type == K_TRAINING:
-                        self.store.state, objv, auc = self._packed_train(
-                            self.store.state, i32, f32, b_cap, nnz_cap,
-                            u_cap, push_cnt, binary)
-                    else:
-                        pred, objv, auc = self._packed_eval(
-                            self.store.state, i32, f32, b_cap, nnz_cap,
-                            u_cap, binary)
-                if job_type == K_PREDICTION and p.pred_out:
-                    self._save_pred(np.asarray(pred)[:blk.size], blk.label)
-                pending.append((blk.size, objv, auc))
-                continue
-
-            cblk, uniq, cnts = payload
-            slots_np, remap, cnts = self.store.map_keys_dedup(uniq, cnts)
-            if remap is not None:
-                # in-batch slot collisions / unsorted slots: point the COO
-                # entries at the deduped sorted rows so colliding features
-                # alias (their gradients segment-sum together on device)
-                cblk = dataclasses.replace(
-                    cblk, index=remap[cblk.index].astype(np.uint32))
-            n_uniq = len(slots_np)
-            u_cap = bucket(n_uniq)
-            b_cap = bucket(blk.size, dim_min)
-            nnz_cap = bucket(blk.nnz, dim_min)
-            if self.mesh is None:
-                # packed path: 2 host->device transfers per batch; slots
-                # pre-padded with ascending OOB indices (store.pad_slots
-                # contract: sorted + unique stays truthful)
-                from ..store.local import pad_slots_oob
-                padded = pad_slots_oob(slots_np, u_cap,
-                                       self.store.state.capacity)
-                i32, f32, binary = pack_batch(
-                    cblk, n_uniq, padded, b_cap, nnz_cap, u_cap,
-                    counts=cnts if push_cnt else None)
+                    pred, objv, auc = self._packed_panel_eval(
+                        self.store.state, i32, f32, b_cap, width,
+                        u_cap, binary, has_rm)
+            else:
+                _, i32, f32, binary, b_cap, nnz_cap, u_cap, has_rm = payload
                 i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
                 if job_type == K_TRAINING:
                     self.store.state, objv, auc = self._packed_train(
-                        self.store.state, i32, f32, b_cap, nnz_cap, u_cap,
-                        push_cnt, binary)
+                        self.store.state, i32, f32, b_cap, nnz_cap,
+                        u_cap, push_cnt, binary, has_rm)
                 else:
                     pred, objv, auc = self._packed_eval(
-                        self.store.state, i32, f32, b_cap, nnz_cap, u_cap,
-                        binary)
-            else:
-                slots = self.store.pad_slots(slots_np, u_cap)
-                dev = pad_batch(cblk, num_uniq=n_uniq,
-                                batch_cap=b_cap, nnz_cap=nnz_cap)
-                from ..parallel import batch_sharding, shard_pytree
-                dev = shard_pytree(dev, batch_sharding(self.mesh))
-                if push_cnt:
-                    c = np.zeros(u_cap, dtype=np.float32)
-                    c[:len(cnts)] = cnts
-                    self.store.state = self._apply_count(
-                        self.store.state, slots, jnp.asarray(c))
-                if job_type == K_TRAINING:
-                    self.store.state, objv, auc = self._train_step(
-                        self.store.state, dev, slots)
-                else:
-                    pred, objv, auc = self._eval_step(self.store.state, dev,
-                                                      slots)
+                        self.store.state, i32, f32, b_cap, nnz_cap,
+                        u_cap, binary, has_rm)
             if job_type == K_PREDICTION and p.pred_out:
-                # stream predictions per batch (SavePred,
-                # sgd_learner.cc:231-238) — don't buffer the dataset
                 self._save_pred(np.asarray(pred)[:blk.size], blk.label)
             pending.append((blk.size, objv, auc))
+            return
 
-        # metric scalars are fetched in ONE transfer after all batches are
-        # dispatched — JAX async dispatch supplies the pipeline overlap
-        if pending:
-            flat = jnp.stack([s for _, o, a in pending for s in (o, a)])
-            vals = np.asarray(flat)
-            for i, (nrows, _, _) in enumerate(pending):
-                prog.merge(Progress(nrows=nrows, loss=float(vals[2 * i]),
-                                    auc=float(vals[2 * i + 1])))
+        cblk, uniq, cnts = payload
+        slots_np, remap, cnts = self.store.map_keys_dedup(uniq, cnts)
+        if remap is not None:
+            # in-batch slot collisions / unsorted slots: point the COO
+            # entries at the deduped sorted rows so colliding features
+            # alias (their gradients segment-sum together on device)
+            cblk = dataclasses.replace(
+                cblk, index=remap[cblk.index].astype(np.uint32))
+        n_uniq = len(slots_np)
+        u_cap = bucket(n_uniq)
+        b_cap = bucket(blk.size, dim_min)
+        nnz_cap = bucket(blk.nnz, dim_min)
+        if self.mesh is None:
+            # packed path: 2 host->device transfers per batch; slots
+            # pre-padded with ascending OOB indices (store.pad_slots
+            # contract: sorted + unique stays truthful)
+            from ..store.local import pad_slots_oob
+            padded = pad_slots_oob(slots_np, u_cap,
+                                   self.store.state.capacity)
+            i32, f32, binary = pack_batch(
+                cblk, n_uniq, padded, b_cap, nnz_cap, u_cap,
+                counts=cnts if push_cnt else None)
+            i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
+            if job_type == K_TRAINING:
+                self.store.state, objv, auc = self._packed_train(
+                    self.store.state, i32, f32, b_cap, nnz_cap, u_cap,
+                    push_cnt, binary)
+            else:
+                pred, objv, auc = self._packed_eval(
+                    self.store.state, i32, f32, b_cap, nnz_cap, u_cap,
+                    binary)
+        else:
+            slots = self.store.pad_slots(slots_np, u_cap)
+            dev = pad_batch(cblk, num_uniq=n_uniq,
+                            batch_cap=b_cap, nnz_cap=nnz_cap)
+            from ..parallel import batch_sharding, shard_pytree
+            dev = shard_pytree(dev, batch_sharding(self.mesh))
+            if push_cnt:
+                c = np.zeros(u_cap, dtype=np.float32)
+                c[:len(cnts)] = cnts
+                self.store.state = self._apply_count(
+                    self.store.state, slots, jnp.asarray(c))
+            if job_type == K_TRAINING:
+                self.store.state, objv, auc = self._train_step(
+                    self.store.state, dev, slots)
+            else:
+                pred, objv, auc = self._eval_step(self.store.state, dev,
+                                                  slots)
+        if job_type == K_PREDICTION and p.pred_out:
+            # stream predictions per batch (SavePred,
+            # sgd_learner.cc:231-238) — don't buffer the dataset
+            self._save_pred(np.asarray(pred)[:blk.size], blk.label)
+        pending.append((blk.size, objv, auc))
 
     def _save_pred(self, pred: np.ndarray, label) -> None:
         """SavePred (sgd_learner.h:72-83); per-rank output file."""

@@ -171,11 +171,20 @@ class SlotStore:
     # contract (L-BFGS/BCD); the SGD hot path fuses these into its jit step.
     def pull(self, keys: np.ndarray) -> Tuple[np.ndarray, Optional[np.ndarray],
                                               Optional[np.ndarray]]:
-        slots = jnp.asarray(self.map_keys(keys))
-        w, V, vmask = self.fns.get_rows(self.state, slots)
-        return (np.asarray(w),
-                None if V is None else np.asarray(V),
-                None if vmask is None else np.asarray(vmask))
+        # get_rows declares sorted+unique indices, but raw map_keys output is
+        # insertion-ordered (dictionary mode) and can repeat (hashed
+        # collisions) — dedup to the sorted unique slot set and remap the
+        # returned rows back to the caller's key order, mirroring push
+        slots_np, remap, _ = self.map_keys_dedup(keys)
+        w, V, vmask = self.fns.get_rows(self.state, jnp.asarray(slots_np))
+        w = np.asarray(w)
+        V = None if V is None else np.asarray(V)
+        vmask = None if vmask is None else np.asarray(vmask)
+        if remap is not None:
+            w = w[remap]
+            V = None if V is None else V[remap]
+            vmask = None if vmask is None else vmask[remap]
+        return w, V, vmask
 
     def push(self, keys: np.ndarray, val_type: int,
              gw: np.ndarray, gV: Optional[np.ndarray] = None,

@@ -23,21 +23,29 @@ from difacto_tpu.learners import Learner  # noqa: E402
 
 out_dir, data = sys.argv[1], sys.argv[2]
 epochs = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+data_val = sys.argv[4] if len(sys.argv) > 4 else ""
 
+args = [("data_in", data), ("V_dim", "2"), ("V_threshold", "2"),
+        ("lr", "0.1"), ("l1", "0.1"), ("l2", "0"),
+        ("batch_size", "100"), ("max_num_epochs", str(epochs)),
+        ("shuffle", "0"), ("report_interval", "0"),
+        ("stop_rel_objv", "0"), ("stop_val_auc", "-2"),
+        ("num_jobs_per_epoch", "1"),
+        ("hash_capacity", str(1 << 20)),
+        ("mesh_dp", "2"), ("mesh_fs", "4"),
+        ("model_out", os.path.join(out_dir, "model"))]
+if data_val:
+    # exercises the SPMD eval path: Reader chunks larger than b_cap must be
+    # sliced into batch_size row windows (advisor round-2 medium finding)
+    args.append(("data_val", data_val))
 ln = Learner.create("sgd")
-ln.init([("data_in", data), ("V_dim", "2"), ("V_threshold", "2"),
-         ("lr", "0.1"), ("l1", "0.1"), ("l2", "0"),
-         ("batch_size", "100"), ("max_num_epochs", str(epochs)),
-         ("shuffle", "0"), ("report_interval", "0"),
-         ("stop_rel_objv", "0"), ("num_jobs_per_epoch", "1"),
-         ("hash_capacity", str(1 << 20)),
-         ("mesh_dp", "2"), ("mesh_fs", "4"),
-         ("model_out", os.path.join(out_dir, "model"))])
-seen = []
-ln.add_epoch_end_callback(lambda e, t, v: seen.append(t.loss))
+ln.init(args)
+seen, seen_val = [], []
+ln.add_epoch_end_callback(
+    lambda e, t, v: (seen.append(t.loss), seen_val.append(v.loss)))
 ln.run()
 
 rank = jax.process_index()
 with open(os.path.join(out_dir, f"traj-{rank}.json"), "w") as f:
-    json.dump(seen, f)
+    json.dump({"train": seen, "val": seen_val}, f)
 print(f"rank {rank} done: {seen}")
