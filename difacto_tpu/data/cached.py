@@ -20,6 +20,7 @@ arithmetic (batch_reader.cc:58-64).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -97,15 +98,30 @@ class CachedBatchReader:
             whole = (len(rows) == blk.size and blk.size <= self.batch_size
                      and not self.shuffle)
             for s in range(0, len(rows), self.batch_size):
+                sel = rows[s:s + self.batch_size]
                 if whole:
                     sub = blk
                 else:
                     b = RowBlockBuilder()
-                    b.push_rows(blk, rows[s:s + self.batch_size])
+                    b.push_rows(blk, sel)
                     sub = b.build()
+                u = uniq
+                if len(sel) < blk.size:
+                    # the batch covers only part of the member: re-compact
+                    # so it ships (and the device step pays u_cap for) only
+                    # ITS distinct features, not the whole member
+                    # vocabulary — members much larger than the training
+                    # batch (the rec_batch_size=0 default) would otherwise
+                    # make the "fast path" slower than the non-cached one
+                    # (round-3 advisor). O(batch nnz) on uint32 positions;
+                    # uniq is sorted, so u stays sorted.
+                    loc, inv = np.unique(sub.index, return_inverse=True)
+                    sub = dataclasses.replace(
+                        sub, index=inv.astype(np.uint32))
+                    u = uniq[loc]
                 counts = None
                 if self.need_counts:
                     counts = np.bincount(
                         sub.index.astype(np.int64),
-                        minlength=len(uniq)).astype(np.float32)
-                yield sub, uniq, counts
+                        minlength=len(u)).astype(np.float32)
+                yield sub, u, counts

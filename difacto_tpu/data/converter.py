@@ -115,12 +115,14 @@ class Converter:
         split = p.part_size > 0
         limit = p.part_size * (1 << 20) if split else None
 
+        import threading
         nrows = 0
         ipart = 0
         nblk = 0
         written = [0]  # compressed bytes in current part (approximate:
         # updated as write futures land; part rollover is checked between
         # member submissions)
+        written_lock = threading.Lock()  # += from concurrent workers
         out_dir = self._open_rec_part(ipart, split)
 
         def write_member(path: str, blk: RowBlock) -> int:
@@ -130,7 +132,8 @@ class Converter:
             else:
                 write_rec_block(path, blk)
             sz = stream.getsize(path)
-            written[0] += sz
+            with written_lock:
+                written[0] += sz
             return sz
 
         def member_blocks(blocks):

@@ -16,9 +16,16 @@ x depth batches are in flight.
 
 A worker that raises re-queues its part via ``pool.reset`` (the dead-node
 path, workload_pool.h:88-105) so another worker can retry it; the retry
-skips the items the failed attempt already enqueued (part iteration is
-deterministic), and the error is re-raised to the consumer only if the part
-keeps failing (max_retries).
+skips the items the failed attempt already enqueued, and the error is
+re-raised to the consumer only if the part keeps failing (max_retries).
+
+**API contract: ``make_iter(part)`` MUST be deterministic** — calling it
+twice for the same part must yield the same item sequence, because the
+retry path resumes via ``islice(make_iter(part), n_delivered)``. A
+nondeterministic iterator (unseeded shuffle, IO-dependent chunking) would
+silently skip or duplicate batches on retry. The learner satisfies this by
+seeding its shuffle/sampling streams per (epoch, part)
+(learners/sgd.py _make_reader).
 """
 
 from __future__ import annotations

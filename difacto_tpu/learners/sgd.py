@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
 from dataclasses import dataclass
 from typing import Optional
@@ -44,6 +45,37 @@ log = logging.getLogger("difacto_tpu")
 # job types (sgd::Job, src/sgd/sgd_utils.h:16-21)
 K_LOAD_MODEL, K_SAVE_MODEL, K_TRAINING, K_VALIDATION, K_PREDICTION, \
     K_EVALUATION = 1, 2, 3, 4, 5, 6
+
+
+class _ShapeSchedule:
+    """Per-run sticky shape caps: every batch pads to the largest bucket
+    seen so far for its (job, dim) key, so steady-state epochs replay ONE
+    compiled step instead of re-bucketing per batch (per-batch ``bucket()``
+    put every odd-sized tail in a fresh jit cache entry — ~10 s/compile on
+    a tunneled chip dominated the whole epoch, round-3 verdict #1). A
+    growing batch costs at most log-many recompiles over the run; caps
+    never shrink. Thread-safe: producer threads prepare batches
+    concurrently."""
+
+    def __init__(self) -> None:
+        self._caps: dict = {}
+        self._lock = threading.Lock()
+
+    def cap(self, key: str, n: int, minimum: int = 8,
+            exact: bool = False) -> int:
+        """``exact`` keeps a plain sticky max instead of bucketing — for
+        dims that are naturally constant (panel width: criteo rows are
+        always 39 wide; bucketing to 48 would inflate every panel cell
+        stream by ~23% and defeat the uniform-reshape fast path)."""
+        with self._lock:
+            c = self._caps.get(key, 0)
+            if n > c or c == 0:
+                # floor degenerate dims like the bucket() it replaces
+                # (bucket(0) == minimum) — empty batches still need
+                # non-zero-sized device shapes
+                c = max(n, 1) if exact else bucket(n, minimum)
+                self._caps[key] = c
+            return c
 
 
 @dataclass
@@ -117,6 +149,11 @@ class SGDLearner(Learner):
                                   fs=self.param.mesh_fs)
         self.store = SlotStore(uparam, mesh=self.mesh)
         self.do_embedding = self.V_dim > 0
+        if self.param.train_auc not in ("binned", "exact", "none"):
+            raise ValueError(
+                f"unknown train_auc {self.param.train_auc!r} "
+                "(expected binned|exact|none)")
+        self._shapes = _ShapeSchedule()
         # multi-controller: this host owns a contiguous slice of the global
         # file parts (parallel/multihost.py; the reference's Rank()/
         # NumWorkers() reader sharding)
@@ -216,6 +253,15 @@ class SGDLearner(Learner):
         p = self.param
         self._start_time = time.time()
         self._report = ReportProg()
+        # live nnz(w)/penalty flow through the Reporter contract
+        # (include/difacto/reporter.h:14-56): the part cadence reports a
+        # Progress delta, the monitor folds in the store's nnz delta (the
+        # reference's servers auto-report new_w, store.h:118-123,
+        # sgd_updater.h:141-147) and prints the throttled row
+        from ..utils.reporter import Reporter
+        self._last_nnz = 0.0
+        self.reporter = Reporter(every=1)
+        self.reporter.set_monitor(self._on_report)
         pre_loss, pre_val_auc = 0.0, 0.0
         k = 0
 
@@ -240,7 +286,14 @@ class SGDLearner(Learner):
         while k < p.max_num_epochs:
             train_prog = Progress()
             self._run_epoch(k, K_TRAINING, train_prog)
-            log.info("epoch[%d] training: %s", k, train_prog.text())
+            # epoch-end model stats: regularization penalty + nnz(w)
+            # (the reference merges these from server Evaluate reports,
+            # sgd_updater.cc:15-32); printed here, unconditionally, so an
+            # all-zero model (nnz 0) is visible rather than suppressed
+            train_prog.penalty, train_prog.nnz_w = self.store.evaluate()
+            log.info("epoch[%d] training: %s, nnz(w) = %g, penalty = %g",
+                     k, train_prog.text(), train_prog.nnz_w,
+                     train_prog.penalty)
 
             val_prog = Progress()
             if p.data_val:
@@ -307,11 +360,22 @@ class SGDLearner(Learner):
         per-batch reporter messages (sgd_learner.cc:242-247)."""
         if job_type != K_TRAINING or self.param.report_interval <= 0:
             return
-        elapsed = time.time() - self._start_time
-        self._report.prog.merge(Progress(
+        self.reporter.report(Progress(
             nrows=prog.nrows - before.nrows,
             loss=prog.loss - before.loss,
             auc=prog.auc - before.auc))
+
+    def _on_report(self, node_id: int, delta: Progress) -> None:
+        """Reporter monitor: fold the store's nnz(w) DELTA into the row
+        (the reference accumulates per-report new_w into the live total,
+        sgd_utils.h:97-110) and print. The penalty half of evaluate() is
+        surfaced on the epoch line instead (_run_epoch), not here — the
+        live row format has no penalty column."""
+        _, nnz = self.store.evaluate()
+        delta.nnz_w = nnz - self._last_nnz
+        self._last_nnz = nnz
+        elapsed = time.time() - self._start_time
+        self._report.prog.merge(delta)
         print(f"{elapsed:5.0f}  {self._report.print_str()}", flush=True)
 
     def _make_reader(self, job_type: int, epoch: int, g_idx: int,
@@ -485,15 +549,20 @@ class SGDLearner(Learner):
             prog.merge(Progress(nrows=nrows, loss=float(np.asarray(objv)),
                                 auc=float(np.asarray(auc))))
 
-    def _prepare_hashed(self, blk, push_cnt: bool, dim_min: int,
+    def _prepare_hashed(self, blk, want_counts: bool, fill_counts: bool,
+                        dim_min: int, job: str,
                         b_cap: Optional[int] = None):
         """Producer-thread batch preparation for the hashed store: ONE
         int32 np.unique collapses localization (Localizer::Compact),
         key->slot mapping, and collision dedup, then the batch packs into
         the two-buffer transfer — panel layout when rows are near-uniform
         (criteo), COO otherwise. Stateless, so safe off-thread. ``b_cap``
-        pins the row cap (the training shape schedule; short tails pad up
-        so epochs never recompile)."""
+        pins the row cap; the remaining dims ride the sticky shape schedule
+        keyed by ``job`` so epochs never recompile. ``want_counts`` keeps
+        the packed counts section (and thus the step's jit signature)
+        present for the WHOLE run; ``fill_counts`` (epoch 0 only) computes
+        real occurrence counts — later epochs ship an all-zero section,
+        making apply_count a no-op instead of a recompile."""
         from ..base import reverse_bytes
         from ..ops.batch import pack_panel, panel_width
         from ..store.local import pad_slots_oob
@@ -501,39 +570,41 @@ class SGDLearner(Learner):
         cap = np.uint64(self.store.param.hash_capacity - 1)
         tok = (reverse_bytes(blk.index) % cap + np.uint64(1)).astype(
             np.int32)
-        if push_cnt:
+        if fill_counts:
             slots, inverse, counts = np.unique(
                 tok, return_inverse=True, return_counts=True)
             counts = counts.astype(np.float32)
         else:
             slots, inverse = np.unique(tok, return_inverse=True)
-            counts = None
+            counts = np.zeros(0, np.float32) if want_counts else None
         cblk = dataclasses.replace(blk, index=inverse.astype(np.uint32))
         n_uniq = len(slots)
-        u_cap = bucket(n_uniq)
-        b_cap = b_cap or bucket(blk.size, dim_min)
+        u_cap = self._shapes.cap(job + ".u", n_uniq)
+        b_cap = b_cap or self._shapes.cap(job + ".b", blk.size, dim_min)
         padded = pad_slots_oob(slots.astype(np.int32), u_cap,
                                self.store.param.hash_capacity)
         width = panel_width(cblk, b_cap)
         if width is not None:
+            width = self._shapes.cap(job + ".w", width, exact=True)
             i32, f32, binary = pack_panel(
-                cblk, n_uniq, padded, b_cap, width, u_cap,
-                counts=counts if push_cnt else None)
+                cblk, n_uniq, padded, b_cap, width, u_cap, counts=counts)
             return ("panel", i32, f32, binary, b_cap, width, u_cap, False)
         from ..ops.batch import pack_batch
-        nnz_cap = bucket(blk.nnz, dim_min)
+        nnz_cap = self._shapes.cap(job + ".nnz", blk.nnz, dim_min)
         i32, f32, binary = pack_batch(
-            cblk, n_uniq, padded, b_cap, nnz_cap, u_cap,
-            counts=counts if push_cnt else None)
+            cblk, n_uniq, padded, b_cap, nnz_cap, u_cap, counts=counts)
         return ("coo", i32, f32, binary, b_cap, nnz_cap, u_cap, False)
 
-    def _prepare_from_uniq(self, cblk, uniq, counts, push_cnt: bool,
-                           dim_min: int, b_cap: Optional[int] = None):
+    def _prepare_from_uniq(self, cblk, uniq, counts, want_counts: bool,
+                           fill_counts: bool, dim_min: int, job: str,
+                           b_cap: Optional[int] = None):
         """Cached fast path (data/cached.py): the block arrives already
         localized to ``uniq`` (sorted reversed ids), so host work is just
         the O(uniq) slot map + dedup; the O(nnz) index array ships
         UNTOUCHED — in-batch hash collisions ride the packed ``remap``
-        vector and are resolved on device (step.py pull/push_grads)."""
+        vector and are resolved on device (step.py pull/push_grads).
+        Shape caps come from the sticky schedule; the counts section stays
+        present all run (see _prepare_hashed)."""
         from ..ops.batch import pack_panel, panel_width
         from ..store.local import pad_slots_oob
 
@@ -541,10 +612,10 @@ class SGDLearner(Learner):
         raw = (uniq % hcap + np.uint64(1)).astype(np.int32)
         slots, remap = np.unique(raw, return_inverse=True)
         n_lanes = len(uniq)
-        u_cap = bucket(n_lanes)
-        b_cap = b_cap or bucket(cblk.size, dim_min)
-        scounts = None
-        if push_cnt and counts is not None:
+        u_cap = self._shapes.cap(job + ".u", n_lanes)
+        b_cap = b_cap or self._shapes.cap(job + ".b", cblk.size, dim_min)
+        scounts = np.zeros(0, np.float32) if want_counts else None
+        if fill_counts and counts is not None:
             # counts are per uniq lane; aggregate to slot space (colliding
             # lanes sum, mirroring map_keys_dedup)
             scounts = np.zeros(u_cap, dtype=np.float32)
@@ -555,12 +626,13 @@ class SGDLearner(Learner):
         remap32 = remap.astype(np.int32)
         width = panel_width(cblk, b_cap)
         if width is not None:
+            width = self._shapes.cap(job + ".w", width, exact=True)
             i32, f32, binary = pack_panel(
                 cblk, n_lanes, padded, b_cap, width, u_cap,
                 counts=scounts, remap=remap32)
             return ("panel", i32, f32, binary, b_cap, width, u_cap, True)
         from ..ops.batch import pack_batch
-        nnz_cap = bucket(cblk.nnz, dim_min)
+        nnz_cap = self._shapes.cap(job + ".nnz", cblk.nnz, dim_min)
         i32, f32, binary = pack_batch(
             cblk, n_lanes, padded, b_cap, nnz_cap, u_cap,
             counts=scounts, remap=remap32)
@@ -609,6 +681,11 @@ class SGDLearner(Learner):
         b_cap_train = bucket(p.batch_size, dim_min)
         cached_uri = self._cached_uri(job_type)
         is_train = job_type == K_TRAINING
+        # the packed steps' counts section (and so their jit signature) is
+        # pinned for the whole run: epochs >= 1 ship zero counts instead of
+        # flipping the has_cnt static and recompiling every shape variant
+        want_counts = is_train and self.do_embedding
+        job = "train" if is_train else "eval"
 
         def make_iter(part):
             # EVERYTHING host-side happens on producer threads so it
@@ -629,7 +706,8 @@ class SGDLearner(Learner):
                 for sub, uniq, cnts in rdr:
                     if hashed_fast:
                         yield ("ready", sub, self._prepare_from_uniq(
-                            sub, uniq, cnts, push_cnt, dim_min,
+                            sub, uniq, cnts, want_counts, push_cnt,
+                            dim_min, job,
                             b_cap_train if is_train else None))
                     else:
                         yield ("compact", sub, (sub, uniq, cnts))
@@ -638,7 +716,7 @@ class SGDLearner(Learner):
             for blk in reader:
                 if hashed_fast:
                     yield ("ready", blk, self._prepare_hashed(
-                        blk, push_cnt, dim_min,
+                        blk, want_counts, push_cnt, dim_min, job,
                         b_cap_train if is_train else None))
                 else:
                     yield ("compact", blk, compact(blk,
@@ -659,26 +737,32 @@ class SGDLearner(Learner):
                 before = Progress(nrows=prog.nrows, loss=prog.loss,
                                   auc=prog.auc)
                 cur_part = part
-            self._dispatch_item(job_type, item, push_cnt, dim_min, pending)
+            self._dispatch_item(job_type, item, push_cnt, want_counts, job,
+                                dim_min, pending)
         self._merge_pending(pending, prog)
         self._report_part(job_type, before, prog)
 
     def _dispatch_item(self, job_type: int, item, push_cnt: bool,
-                       dim_min: int, pending: list) -> None:
-        """Consume one produced batch: stage + run the fused device step."""
+                       want_counts: bool, job: str, dim_min: int,
+                       pending: list) -> None:
+        """Consume one produced batch: stage + run the fused device step.
+        ``want_counts``/``job`` arrive from _iterate_parts so producer-side
+        packing and this consumer agree on the run-stable has_cnt static
+        and the shape-schedule key."""
         p = self.param
         from ..ops.batch import pack_batch
         kind, blk, payload = item
+        is_train = job_type == K_TRAINING
         if kind == "ready":
             layout = payload[0]
             if layout == "panel":
                 _, i32, f32, binary, b_cap, width, u_cap, has_rm = payload
                 i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
-                if job_type == K_TRAINING:
+                if is_train:
                     self.store.state, objv, auc = \
                         self._packed_panel_train(
                             self.store.state, i32, f32, b_cap, width,
-                            u_cap, push_cnt, binary, has_rm)
+                            u_cap, want_counts, binary, has_rm)
                 else:
                     pred, objv, auc = self._packed_panel_eval(
                         self.store.state, i32, f32, b_cap, width,
@@ -686,10 +770,10 @@ class SGDLearner(Learner):
             else:
                 _, i32, f32, binary, b_cap, nnz_cap, u_cap, has_rm = payload
                 i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
-                if job_type == K_TRAINING:
+                if is_train:
                     self.store.state, objv, auc = self._packed_train(
                         self.store.state, i32, f32, b_cap, nnz_cap,
-                        u_cap, push_cnt, binary, has_rm)
+                        u_cap, want_counts, binary, has_rm)
                 else:
                     pred, objv, auc = self._packed_eval(
                         self.store.state, i32, f32, b_cap, nnz_cap,
@@ -708,9 +792,9 @@ class SGDLearner(Learner):
             cblk = dataclasses.replace(
                 cblk, index=remap[cblk.index].astype(np.uint32))
         n_uniq = len(slots_np)
-        u_cap = bucket(n_uniq)
-        b_cap = bucket(blk.size, dim_min)
-        nnz_cap = bucket(blk.nnz, dim_min)
+        u_cap = self._shapes.cap(job + ".u", n_uniq)
+        b_cap = self._shapes.cap(job + ".b", blk.size, dim_min)
+        nnz_cap = self._shapes.cap(job + ".nnz", blk.nnz, dim_min)
         if self.mesh is None:
             # packed path: 2 host->device transfers per batch; slots
             # pre-padded with ascending OOB indices (store.pad_slots
@@ -718,14 +802,16 @@ class SGDLearner(Learner):
             from ..store.local import pad_slots_oob
             padded = pad_slots_oob(slots_np, u_cap,
                                    self.store.state.capacity)
+            if want_counts and not push_cnt:
+                cnts = np.zeros(0, np.float32)  # keep the section, zeroed
             i32, f32, binary = pack_batch(
                 cblk, n_uniq, padded, b_cap, nnz_cap, u_cap,
-                counts=cnts if push_cnt else None)
+                counts=cnts if want_counts else None)
             i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
-            if job_type == K_TRAINING:
+            if is_train:
                 self.store.state, objv, auc = self._packed_train(
                     self.store.state, i32, f32, b_cap, nnz_cap, u_cap,
-                    push_cnt, binary)
+                    want_counts, binary)
             else:
                 pred, objv, auc = self._packed_eval(
                     self.store.state, i32, f32, b_cap, nnz_cap, u_cap,

@@ -10,7 +10,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from .fm import (FMParams, fm_grad, fm_grad_panel, fm_predict,
-                 fm_predict_panel, logit_objv)
+                 fm_predict_panel, fm_predict_panel_xv, fm_predict_xv,
+                 logit_objv)
 from . import metrics
 
 
@@ -25,11 +26,19 @@ class LossSpec:
             return fm_predict_panel(params, batch)
         return fm_predict(params, batch)
 
-    def calc_grad(self, params: FMParams, batch, pred):
+    def predict_xv(self, params: FMParams, batch):
+        """(pred, XV-or-None): the forward plus its X·V byproduct, which
+        calc_grad reuses so the fused train step gathers tokens ONCE."""
         from ..ops.batch import PanelBatch
         if isinstance(batch, PanelBatch):
-            return fm_grad_panel(params, batch, pred)
-        return fm_grad(params, batch, pred)
+            return fm_predict_panel_xv(params, batch)
+        return fm_predict_xv(params, batch)
+
+    def calc_grad(self, params: FMParams, batch, pred, xv=None):
+        from ..ops.batch import PanelBatch
+        if isinstance(batch, PanelBatch):
+            return fm_grad_panel(params, batch, pred, xv)
+        return fm_grad(params, batch, pred, xv)
 
     def evaluate(self, pred, batch):
         return logit_objv(pred, batch)
@@ -44,6 +53,6 @@ def create(name: str, V_dim: int = 0) -> LossSpec:
     raise ValueError(f"unknown loss type: {name!r}")
 
 
-__all__ = ["FMParams", "fm_predict", "fm_grad", "fm_predict_panel",
-           "fm_grad_panel", "logit_objv", "LossSpec",
-           "create", "metrics"]
+__all__ = ["FMParams", "fm_predict", "fm_predict_xv", "fm_grad",
+           "fm_predict_panel", "fm_predict_panel_xv", "fm_grad_panel",
+           "logit_objv", "LossSpec", "create", "metrics"]

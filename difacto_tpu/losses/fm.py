@@ -46,16 +46,25 @@ def _vmask(params: FMParams) -> jnp.ndarray:
     return params.v_mask
 
 
-def fm_predict(params: FMParams, batch: DeviceBatch) -> jnp.ndarray:
-    """pred[B]; padding rows produce garbage — mask at use sites."""
+def fm_predict_xv(params: FMParams, batch: DeviceBatch
+                  ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """(pred[B], XV[B,k] or None); padding rows produce garbage — mask at
+    use sites. XV is handed to the backward so the fused train step never
+    recomputes the X·V SpMM (round-4 profile: the backward's duplicate
+    token gather was ~15% of the step)."""
     B = batch.batch_cap
     pred = spmv(batch.vals, batch.rows, batch.cols, params.w, B)
+    XV = None
     if params.V is not None and params.V.shape[1] > 0:
         Vm = params.V * _vmask(params)[:, None]
         XV = spmm(batch.vals, batch.rows, batch.cols, Vm, B)
         XXVV = spmm(batch.vals ** 2, batch.rows, batch.cols, Vm ** 2, B)
         pred = pred + 0.5 * jnp.sum(XV ** 2 - XXVV, axis=1)
-    return jnp.clip(pred, -PRED_CLAMP, PRED_CLAMP)
+    return jnp.clip(pred, -PRED_CLAMP, PRED_CLAMP), XV
+
+
+def fm_predict(params: FMParams, batch: DeviceBatch) -> jnp.ndarray:
+    return fm_predict_xv(params, batch)[0]
 
 
 def _p_vector(pred: jnp.ndarray, batch: DeviceBatch) -> jnp.ndarray:
@@ -65,9 +74,11 @@ def _p_vector(pred: jnp.ndarray, batch: DeviceBatch) -> jnp.ndarray:
     return p * batch.rweight * batch.row_mask
 
 
-def fm_grad(params: FMParams, batch: DeviceBatch, pred: jnp.ndarray
+def fm_grad(params: FMParams, batch: DeviceBatch, pred: jnp.ndarray,
+            xv: Optional[jnp.ndarray] = None
             ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
-    """Returns (gw[U], gV[U,k] or None)."""
+    """Returns (gw[U], gV[U,k] or None). ``xv`` is the forward's X·V
+    (fm_predict_xv); None recomputes it."""
     U = params.w.shape[0]
     p = _p_vector(pred, batch)
     gw = spmv_t(batch.vals, batch.rows, batch.cols, p, U)
@@ -75,7 +86,8 @@ def fm_grad(params: FMParams, batch: DeviceBatch, pred: jnp.ndarray
         return gw, None
     vm = _vmask(params)
     Vm = params.V * vm[:, None]
-    XV = spmm(batch.vals, batch.rows, batch.cols, Vm, batch.batch_cap)
+    XV = xv if xv is not None else spmm(batch.vals, batch.rows, batch.cols,
+                                        Vm, batch.batch_cap)
     # X' diag(p) X V
     t1 = spmm_t(batch.vals, batch.rows, batch.cols, p[:, None] * XV, U)
     # diag((X.X)'p) V
@@ -84,16 +96,19 @@ def fm_grad(params: FMParams, batch: DeviceBatch, pred: jnp.ndarray
     return gw, gV
 
 
-def fm_predict_panel(params: FMParams, pb) -> jnp.ndarray:
+def fm_predict_panel_xv(params: FMParams, pb
+                        ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Panel-layout forward (ops/batch.py PanelBatch): ONE [B,F]-cell
     gather of combined [w | V] rows, then dense reductions over the fixed
     row width — no COO segment machinery. Same arithmetic as fm_predict
-    (fm_loss.h:43,67-119)."""
+    (fm_loss.h:43,67-119). Returns (pred, XV) so the backward can skip the
+    duplicate token gather (its only use of per-token V is recomputing
+    XV — ~330 MB/step at bench shapes)."""
     if params.V is None or params.V.shape[1] == 0:
         wc = params.w[pb.idx]                       # [B, F]
         if pb.vals is not None:
             wc = wc * pb.vals
-        return jnp.clip(jnp.sum(wc, axis=1), -PRED_CLAMP, PRED_CLAMP)
+        return jnp.clip(jnp.sum(wc, axis=1), -PRED_CLAMP, PRED_CLAMP), None
     # the [U, 1+k] combined rows keep V's STORAGE dtype: with bf16 V_dtype
     # the per-token gather (the step's largest stream at big batches)
     # moves half the bytes; accumulation is f32 below
@@ -110,15 +125,21 @@ def fm_predict_panel(params: FMParams, pb) -> jnp.ndarray:
     XV = jnp.sum(t, axis=1)
     XXVV = jnp.sum(t * t, axis=1)
     pred = pred + 0.5 * jnp.sum(XV * XV - XXVV, axis=1)
-    return jnp.clip(pred, -PRED_CLAMP, PRED_CLAMP)
+    return jnp.clip(pred, -PRED_CLAMP, PRED_CLAMP), XV
 
 
-def fm_grad_panel(params: FMParams, pb, pred: jnp.ndarray
+def fm_predict_panel(params: FMParams, pb) -> jnp.ndarray:
+    return fm_predict_panel_xv(params, pb)[0]
+
+
+def fm_grad_panel(params: FMParams, pb, pred: jnp.ndarray,
+                  xv: Optional[jnp.ndarray] = None
                   ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Panel-layout backward: per-cell contributions are pure BROADCASTS
     of row quantities (p, p*XV), merged by ONE combined segment reduction
     [B*F, k+2] -> [U, k+2] for (t1 | gw | xxp). Same math as fm_grad
-    (fm_loss.h:124-126,148-203)."""
+    (fm_loss.h:124-126,148-203). ``xv`` is the forward's X·V
+    (fm_predict_panel_xv); None re-gathers the tokens to rebuild it."""
     U = params.w.shape[0]
     B, F = pb.idx.shape
     p = _p_vector(pred, pb)                          # [B]
@@ -133,12 +154,13 @@ def fm_grad_panel(params: FMParams, pb, pred: jnp.ndarray
     k = params.V.shape[1]
     vm = _vmask(params)
     Vm = (params.V * vm.astype(params.V.dtype)[:, None])
-    # recompute XV from the forward's gather (cheap relative to a cache);
-    # storage-dtype gather, f32 accumulation (see fm_predict_panel)
-    t = Vm[pb.idx]
-    if pb.vals is not None:
-        t = t * pb.vals[:, :, None].astype(t.dtype)
-    XV = jnp.sum(t.astype(jnp.float32), axis=1)
+    if xv is not None:
+        XV = xv
+    else:
+        t = Vm[pb.idx]
+        if pb.vals is not None:
+            t = t * pb.vals[:, :, None].astype(t.dtype)
+        XV = jnp.sum(t.astype(jnp.float32), axis=1)
     Vm = Vm.astype(jnp.float32)
     pXV = p[:, None] * XV                            # [B, k]
     contrib = jnp.concatenate([

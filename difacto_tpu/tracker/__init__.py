@@ -1,6 +1,11 @@
-"""Control plane: job tracking, workload distribution, failure handling."""
+"""Control plane: workload distribution and failure handling.
 
-from .async_tracker import AsyncTracker
+The reference's AsyncLocalTracker (src/tracker/async_local_tracker.h) is
+superseded by data/producer_pool.OrderedProducerPool, which fills the same
+issue/execute/monitor role against the WorkloadPool (round-3 verdict:
+fold or delete — folded).
+"""
+
 from .workload_pool import WorkloadPool, WorkloadPoolParam
 
-__all__ = ["AsyncTracker", "WorkloadPool", "WorkloadPoolParam"]
+__all__ = ["WorkloadPool", "WorkloadPoolParam"]

@@ -31,8 +31,9 @@ class Progress:
 
     def text(self) -> str:
         n = max(self.nrows, 1.0)
-        return (f"Rows = {self.nrows:g}, loss = {self.loss / n:.6f}, "
-                f"AUC = {self.auc / n:.6f}")
+        s = (f"Rows = {self.nrows:g}, loss = {self.loss / n:.6f}, "
+             f"AUC = {self.auc / n:.6f}")
+        return s
 
 
 class ReportProg:

@@ -1,25 +1,11 @@
-"""Hashed store mode, VectorClock, Range, and host-part plumbing tests."""
+"""Hashed store mode, VectorClock, and host-part plumbing tests."""
 
 import numpy as np
 import pytest
 
 from difacto_tpu.learners import Learner
-from difacto_tpu.ops.range import Range
 from difacto_tpu.parallel.multihost import host_part
 from difacto_tpu.store.vector_clock import VectorClock
-
-
-def test_range_segment_partitions():
-    r = Range(0, 100)
-    segs = [r.segment(i, 7) for i in range(7)]
-    assert segs[0].begin == 0 and segs[-1].end == 100
-    for a, b in zip(segs, segs[1:]):
-        assert a.end == b.begin
-    assert sum(s.size for s in segs) == 100
-    assert r.has(0) and not r.has(100)
-    assert (Range(1, 3) * 4) == Range(4, 12)
-    with pytest.raises(ValueError):
-        r.segment(7, 7)
 
 
 def test_vector_clock():

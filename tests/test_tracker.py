@@ -1,6 +1,5 @@
 """Control-plane tests: WorkloadPool (assignment, dead-node reset, straggler
-re-issue — src/reader/workload_pool.h), AsyncTracker (async_local_tracker.h),
-Reporter, and the prefetcher."""
+re-issue — src/reader/workload_pool.h), Reporter, and the prefetcher."""
 
 import time
 
@@ -8,7 +7,7 @@ import numpy as np
 import pytest
 
 from difacto_tpu.data.prefetch import prefetch
-from difacto_tpu.tracker import AsyncTracker, WorkloadPool, WorkloadPoolParam
+from difacto_tpu.tracker import WorkloadPool, WorkloadPoolParam
 from difacto_tpu.utils.reporter import Reporter
 
 
@@ -57,44 +56,6 @@ def test_pool_straggler_needs_history():
     pool.add(2)
     pool.get(node=1)
     assert pool.remove_stragglers(now=time.time() + 3600) == []
-
-
-def test_async_tracker_exec_and_monitor():
-    tr = AsyncTracker()
-    seen = []
-    tr.set_executor(lambda j: j * 2)
-    tr.set_monitor(lambda job, res: seen.append((job, res)))
-    assert tr.issue_and_wait([1, 2, 3]) == [2, 4, 6]
-    assert sorted(seen) == [(1, 2), (2, 4), (3, 6)]
-    tr.stop()
-
-
-def test_async_tracker_backpressure_and_wait():
-    tr = AsyncTracker()
-    tr.set_executor(lambda j: time.sleep(0.01) or j)
-    for i in range(5):
-        tr.issue(i)
-    assert tr.num_remains() > 0
-    tr.wait()
-    assert tr.num_remains() == 0
-    tr.stop()
-
-
-def test_async_tracker_error_propagates():
-    tr = AsyncTracker()
-    tr.set_executor(lambda j: 1 / 0)
-    tr.issue(1)
-    with pytest.raises(RuntimeError):
-        tr.wait()
-    tr.stop()
-
-
-def test_async_tracker_error_unblocks_issue_and_wait():
-    tr = AsyncTracker()
-    tr.set_executor(lambda j: 1 / 0)
-    with pytest.raises(RuntimeError):
-        tr.issue_and_wait([1, 2])  # must raise, not deadlock
-    tr.stop()
 
 
 def test_reporter_throttle():
