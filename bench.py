@@ -198,8 +198,8 @@ def main() -> None:
                            "step)")
     mode.add_argument("--device-only", action="store_true",
                       help="device step only (skip the e2e pipeline run)")
-    ap.add_argument("--e2e-rows", type=int, default=200_000)
-    ap.add_argument("--e2e-batch", type=int, default=16384,
+    ap.add_argument("--e2e-rows", type=int, default=600_000)
+    ap.add_argument("--e2e-batch", type=int, default=32768,
                     help="training batch size for the e2e pipeline run")
     args = ap.parse_args()
 
@@ -217,29 +217,31 @@ def main() -> None:
     # stack the batches on device and run ALL steps inside one lax.scan:
     # a single dispatch + a value fetch, so the measurement is pure device
     # execution (per-step host dispatch RTT would otherwise dominate, and
-    # block_until_ready is unreliable through the device tunnel)
+    # block_until_ready is unreliable through the device tunnel).
+    # stacked/slots ride as EXPLICIT jit arguments — closed-over device
+    # arrays become executable constants and re-upload through the slow
+    # tunnel on every compile (docs/perf_notes.md pitfall #2)
     stacked = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs), *[b for b, _ in host_batches])
     slots = jnp.stack([jnp.asarray(s) for _, s in host_batches])
     n_bk = len(host_batches)
     u_cap = slots.shape[1]
 
-    def scan_body(state, i):
-        batch = jax.tree_util.tree_map(lambda x: x[i % n_bk], stacked)
-        state, objv, auc = step(state, batch, slots[i % n_bk])
-        return state, objv
-
     @jax.jit
-    def run_steps(state):
+    def run_steps(state, stacked, slots):
+        def scan_body(state, i):
+            batch = jax.tree_util.tree_map(lambda x: x[i % n_bk], stacked)
+            state, objv, auc = step(state, batch, slots[i % n_bk])
+            return state, objv
         return jax.lax.scan(scan_body, state,
                             jnp.arange(args.steps, dtype=jnp.int32))
 
     # warmup / compile (fetch forces completion)
-    state, objvs = run_steps(state)
+    state, objvs = run_steps(state, stacked, slots)
     float(objvs[-1])
 
     t0 = time.perf_counter()
-    state, objvs = run_steps(state)
+    state, objvs = run_steps(state, stacked, slots)
     float(objvs[-1])
     dt = time.perf_counter() - t0
 
