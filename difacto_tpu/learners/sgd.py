@@ -47,6 +47,67 @@ K_LOAD_MODEL, K_SAVE_MODEL, K_TRAINING, K_VALIDATION, K_PREDICTION, \
     K_EVALUATION = 1, 2, 3, 4, 5, 6
 
 
+class _DeviceBatchCache:
+    """Device-resident replay cache for the packed single-host hashed path.
+
+    Host->device transfer through a tunneled/remote chip measures ~5-10 MB/s
+    while the fused step consumes packed batches far faster — steady-state
+    epochs were transfer-bound (round-4 probe: 4 MB/batch at ~5 MB/s vs a
+    ~30 ms device step). The first pass over a part stages each packed batch
+    once and keeps the device buffers; later epochs replay them straight
+    from HBM with ZERO host->device traffic. The TPU-native analog of the
+    reference caching training data in memory between passes
+    (src/data/tile_store.h:32-168) — here the cached unit is the packed,
+    already-localized device batch.
+
+    Only the hashed store qualifies: its capacity is fixed, so cached slot
+    vectors (including their out-of-bounds padding) stay truthful forever;
+    the dictionary store can grow, which would pull padded indices back in
+    bounds. Shuffle degrades to a per-epoch permutation of cached batches
+    within each part (row->batch assignment is frozen at staging time);
+    neg_sampling != 1 disables the cache (each epoch must resample).
+    """
+
+    def __init__(self, budget_mb: int, shared: Optional[dict] = None) -> None:
+        """``shared`` is a mutable ``{"used": bytes}`` pool: all caches of
+        one learner (training + validation) draw from the SAME
+        device_cache_mb budget, so actual HBM held never exceeds the
+        configured cap however many job types cache."""
+        self.budget = budget_mb << 20
+        self.shared = shared if shared is not None else {"used": 0}
+        self.used = 0
+        self.entries: dict = {}   # part -> list of payload tuples
+        self.ready = False        # becomes True after one full pass
+        self.alive = True
+
+    def add(self, part: int, payload, nbytes: int) -> None:
+        if not self.alive:
+            return
+        self.used += nbytes
+        self.shared["used"] += nbytes
+        if self.shared["used"] > self.budget:
+            self.alive = False
+            self.entries.clear()
+            self.shared["used"] -= self.used
+            log.info("device batch cache over budget (%d MB total) — "
+                     "streaming", self.budget >> 20)
+            return
+        self.entries.setdefault(part, []).append(payload)
+
+    def finish_pass(self) -> None:
+        if self.alive:
+            self.ready = True
+
+    def iter_parts(self, shuffle: bool, seed: int):
+        rng = np.random.RandomState(seed)
+        for part in sorted(self.entries):
+            items = self.entries[part]
+            order = rng.permutation(len(items)) if shuffle \
+                else range(len(items))
+            for i in order:
+                yield part, items[i]
+
+
 class _ShapeSchedule:
     """Per-run sticky shape caps: every batch pads to the largest bucket
     seen so far for its (job, dim) key, so steady-state epochs replay ONE
@@ -107,9 +168,27 @@ class SGDLearnerParam(Param):
     # trajectories stay deterministic.
     num_producers: int = 0
     producer_depth: int = 3
+    # re-issue a part stuck on a producer for > max(10 x mean part time,
+    # this many seconds); 0 disables (straggler_timeout,
+    # src/reader/workload_pool.h:155-176). Safe: generation-guarded
+    # delivery keeps items exactly-once even if the original attempt wakes
+    # up later (data/producer_pool.py).
+    straggler_timeout: float = 0.0
     # per-step training metric: "binned" = O(B) histogram AUC (default),
     # "exact" = argsort AUC, "none". Validation is always exact (step.py).
     train_auc: str = "binned"
+    # HBM budget for the device-resident batch replay cache (0 disables).
+    # Single-host hashed-store runs stage each packed batch once and replay
+    # it from device memory every later epoch — essential when the
+    # host<->device link is slow (tunneled chips measure ~5-10 MB/s).
+    device_cache_mb: int = 2048
+    # fault tolerance (parallel/fault.py): checkpoint every k epochs to
+    # model_out WITH optimizer state (0 = only the final save), and resume
+    # automatically from the newest such checkpoint at startup — the
+    # recovery half of the dead-host protocol (the reference reloads a
+    # saved model after a server loss, SURVEY §5.3).
+    ckpt_interval: int = 0
+    auto_resume: bool = False
     # SPMD mesh (parallel/mesh.py): feature shards ("servers") × data
     # parallelism ("workers"); 1×1 = single device. The reference analog is
     # launch.py's -s/-n server/worker counts.
@@ -159,6 +238,11 @@ class SGDLearner(Learner):
         # NumWorkers() reader sharding)
         from ..parallel.multihost import host_part
         self._host_rank, self._num_hosts = host_part()
+        # dead-host detection: UDP heartbeat mesh + blocked-collective
+        # watchdog (parallel/fault.py; the reference's GetDeadNodes poll,
+        # dist_tracker.h:164-186). Enabled by launch.py via DIFACTO_HB_*.
+        from ..parallel import fault
+        self.monitor = fault.from_env(self._host_rank, self._num_hosts)
         if self._num_hosts > 1:
             if self.mesh is not None:
                 # synchronized-step SPMD over a global mesh: every host
@@ -246,6 +330,11 @@ class SGDLearner(Learner):
                                            static_argnums=(3, 4, 5, 6, 7, 8))
         self._packed_panel_eval = jax.jit(packed_panel_eval,
                                           static_argnums=(3, 4, 5, 6, 7))
+        # device-side zeroing of the packed f32 counts tail: replayed cache
+        # entries must not re-push epoch-0 feature counts
+        self._zero_counts = jax.jit(
+            lambda f32, u_cap: f32.at[f32.shape[0] - u_cap:].set(0.0),
+            static_argnums=1)
 
     # ----------------------------------------------------------- driver
     def run(self) -> None:
@@ -265,7 +354,12 @@ class SGDLearner(Learner):
         pre_loss, pre_val_auc = 0.0, 0.0
         k = 0
 
-        if p.model_in:
+        if p.auto_resume and p.model_out:
+            resumed = self._try_resume()
+            if resumed is not None:
+                k = resumed + 1
+                log.info("auto-resumed from epoch %d checkpoint", resumed)
+        if k == 0 and p.model_in:
             if p.load_epoch >= 0:
                 log.info("loading model from epoch %d", p.load_epoch)
                 self.store.load(self._model_name(p.model_in, p.load_epoch))
@@ -290,7 +384,7 @@ class SGDLearner(Learner):
             # (the reference merges these from server Evaluate reports,
             # sgd_updater.cc:15-32); printed here, unconditionally, so an
             # all-zero model (nnz 0) is visible rather than suppressed
-            train_prog.penalty, train_prog.nnz_w = self.store.evaluate()
+            train_prog.penalty, train_prog.nnz_w = self._take_eval_scalars()
             log.info("epoch[%d] training: %s, nnz(w) = %g, penalty = %g",
                      k, train_prog.text(), train_prog.nnz_w,
                      train_prog.penalty)
@@ -302,6 +396,17 @@ class SGDLearner(Learner):
 
             for cb in self.epoch_end_callbacks:
                 cb(k, train_prog, val_prog)
+
+            if p.ckpt_interval > 0 and p.model_out \
+                    and (k + 1) % p.ckpt_interval == 0:
+                # periodic checkpoint WITH optimizer state so a restarted
+                # run continues the exact trajectory; the meta marker is
+                # written last (by host 0) so a crash mid-save resumes
+                # from the previous complete epoch
+                self.store.save(self._model_name(p.model_out, k),
+                                save_aux=True)
+                if self._host_rank == 0:
+                    self._write_ckpt_meta(k)
 
             # stop criteria (sgd_learner.cc:92-110): the reference divides by
             # pre_loss with no zero guard — first epoch never triggers
@@ -341,6 +446,41 @@ class SGDLearner(Learner):
         if it >= 0:
             name += f"_iter-{it}"
         return name + f"_part-{self._host_rank}"
+
+    def _meta_path(self) -> str:
+        return self.param.model_out + ".meta"
+
+    def _write_ckpt_meta(self, epoch: int) -> None:
+        import json
+
+        from ..utils import stream
+        with stream.open_stream(self._meta_path(), "w") as f:
+            f.write(json.dumps({"last_epoch": epoch}))
+
+    def _try_resume(self) -> Optional[int]:
+        """Load the newest interval checkpoint (ckpt_interval/auto_resume;
+        the recovery leg of parallel/fault.py). Returns the completed epoch
+        or None. A host joining after an eviction may not have written the
+        part file itself — any rank's part works, because the hashed-store
+        state is host-complete (replicated over dp, multihost.py)."""
+        import json
+
+        from ..utils import stream
+        try:
+            with stream.open_stream(self._meta_path(), "r") as f:
+                epoch = int(json.loads(f.read())["last_epoch"])
+        except (FileNotFoundError, OSError, ValueError, KeyError):
+            return None
+        base = self.param.model_out + f"_iter-{epoch}_part-"
+        for rank in [self._host_rank] + list(range(self._num_hosts + 8)):
+            try:
+                self.store.load(base + str(rank))
+                return epoch
+            except (FileNotFoundError, OSError):
+                continue
+        log.warning("checkpoint meta found but no loadable part for "
+                    "epoch %d; starting fresh", epoch)
+        return None
 
     def _run_epoch(self, epoch: int, job_type: int, prog: Progress) -> None:
         p = self.param
@@ -472,7 +612,14 @@ class SGDLearner(Learner):
                     payload[u_cap:u_cap + nu] = cnts.astype(np.int64)
                 payload[-2] = blk.size
                 payload[-1] = 1
-            g = allgather_np(payload)          # [n_hosts, 2u+2]
+            # DCN control-plane exchange, guarded by the dead-host monitor:
+            # a dead peer raises HostFailure before entry (or aborts via
+            # the watchdog if it dies mid-collective) instead of hanging
+            # the surviving hosts forever
+            if self.monitor is not None:
+                g = self.monitor.guarded(allgather_np, payload)
+            else:
+                g = allgather_np(payload)      # [n_hosts, 2u+2]
             if g[:, -1].max() == 0:
                 break
             union = np.unique(g[:, :u_cap])
@@ -545,9 +692,18 @@ class SGDLearner(Learner):
                         local_rows(pred, lo, lo + cblk.size), cblk.label)
             pending.append((nrows_g, objv, auc))
 
-        for nrows, objv, auc in pending:
-            prog.merge(Progress(nrows=nrows, loss=float(np.asarray(objv)),
-                                auc=float(np.asarray(auc))))
+        # draining the pending step results blocks on device programs that
+        # contain cross-host collectives — keep the dead-host watchdog armed
+        # (a peer dying after the final allgather but before its queued
+        # steps complete would otherwise hang this fetch forever)
+        import contextlib
+        drain_guard = (self.monitor.collective() if self.monitor is not None
+                       else contextlib.nullcontext())
+        with drain_guard:
+            for nrows, objv, auc in pending:
+                prog.merge(Progress(nrows=nrows,
+                                    loss=float(np.asarray(objv)),
+                                    auc=float(np.asarray(auc))))
 
     def _prepare_hashed(self, blk, want_counts: bool, fill_counts: bool,
                         dim_min: int, job: str,
@@ -655,16 +811,76 @@ class SGDLearner(Learner):
                 self._cache_probe[uri] = False
         return uri if self._cache_probe[uri] else None
 
-    def _merge_pending(self, pending: list, prog: Progress) -> None:
+    def _merge_pending(self, pending: list, prog: Progress,
+                       extra=()) -> list:
         """Fetch all dispatched metric scalars in ONE transfer and merge —
-        JAX async dispatch supplies the pipeline overlap."""
-        if not pending:
-            return
-        flat = jnp.stack([s for _, o, a in pending for s in (o, a)])
+        JAX async dispatch supplies the pipeline overlap. ``extra`` device
+        scalars ride the same fetch (their values are returned): one RTT
+        instead of two for the epoch-end store.evaluate()."""
+        extra = list(extra)
+        if not pending and not extra:
+            return []
+        flat = jnp.stack([s for _, o, a in pending for s in (o, a)]
+                         + extra)
         vals = np.asarray(flat)
         for i, (nrows, _, _) in enumerate(pending):
             prog.merge(Progress(nrows=nrows, loss=float(vals[2 * i]),
                                 auc=float(vals[2 * i + 1])))
+        return [float(v) for v in vals[2 * len(pending):]]
+
+    def _get_cache(self, job_type: int) -> Optional[_DeviceBatchCache]:
+        """The device replay cache for this job, or None when ineligible
+        (see _DeviceBatchCache docstring for the constraints)."""
+        p = self.param
+        if (p.device_cache_mb <= 0 or self.mesh is not None
+                or self._num_hosts > 1 or not self.store.hashed
+                or job_type not in (K_TRAINING, K_VALIDATION)
+                or (job_type == K_TRAINING and p.neg_sampling != 1.0)):
+            return None
+        if not hasattr(self, "_dev_caches"):
+            self._dev_caches = {}
+            self._dev_cache_pool = {"used": 0}  # one budget across jobs
+        if job_type not in self._dev_caches:
+            self._dev_caches[job_type] = _DeviceBatchCache(
+                p.device_cache_mb, shared=self._dev_cache_pool)
+        return self._dev_caches[job_type]
+
+    def _replay_cached(self, job_type: int, epoch: int,
+                       cache: _DeviceBatchCache, prog: Progress) -> None:
+        """Steady-state epoch: replay HBM-resident packed batches — zero
+        host->device transfers, shuffle = per-epoch batch permutation."""
+        p = self.param
+        is_train = job_type == K_TRAINING
+        pending: list = []
+        cur_part = 0
+        before = Progress(nrows=prog.nrows, loss=prog.loss, auc=prog.auc)
+        for part, payload in cache.iter_parts(
+                is_train and p.shuffle > 0, seed=epoch):
+            if part != cur_part:
+                self._merge_pending(pending, prog)
+                pending = []
+                self._report_part(job_type, before, prog)
+                before = Progress(nrows=prog.nrows, loss=prog.loss,
+                                  auc=prog.auc)
+                cur_part = part
+            self._dispatch_packed(job_type, payload, pending)
+        self._final_merge(job_type, pending, prog)
+        self._report_part(job_type, before, prog)
+
+    def _final_merge(self, job_type: int, pending: list, prog: Progress
+                     ) -> None:
+        """Epoch-final metric fetch; training epochs piggyback the store's
+        (penalty, nnz) scalars on the same transfer (run() reads them via
+        _take_eval_scalars) — one RTT instead of two per epoch."""
+        extra = self.store.evaluate_dev() if job_type == K_TRAINING else ()
+        vals = self._merge_pending(pending, prog, extra=extra)
+        if extra:
+            self._eval_scalars = (vals[0], vals[1])
+
+    def _take_eval_scalars(self):
+        s = getattr(self, "_eval_scalars", None)
+        self._eval_scalars = None
+        return s if s is not None else self.store.evaluate()
 
     def _iterate_parts(self, job_type: int, epoch: int, n_jobs: int,
                        prog: Progress) -> None:
@@ -673,6 +889,10 @@ class SGDLearner(Learner):
         pool (data/producer_pool.py) and consumed in canonical order."""
         import os
         p = self.param
+        cache = self._get_cache(job_type)
+        if cache is not None and cache.ready:
+            self._replay_cached(job_type, epoch, cache, prog)
+            return
         push_cnt = (job_type == K_TRAINING and epoch == 0
                     and self.do_embedding)
         from ..ops.batch import mesh_dim_min
@@ -723,9 +943,13 @@ class SGDLearner(Learner):
                                                    need_counts=push_cnt))
 
         from ..data.producer_pool import OrderedProducerPool
+        from ..tracker.workload_pool import (WorkloadPool,
+                                             WorkloadPoolParam)
         n_workers = p.num_producers or max(1, min(4, os.cpu_count() or 1))
+        wp = WorkloadPool(WorkloadPoolParam(
+            straggler_timeout=p.straggler_timeout))
         pool = OrderedProducerPool(n_jobs, make_iter, n_workers=n_workers,
-                                   depth=p.producer_depth)
+                                   depth=p.producer_depth, pool=wp)
         pending: list = []
         cur_part = 0
         before = Progress(nrows=prog.nrows, loss=prog.loss, auc=prog.auc)
@@ -738,13 +962,47 @@ class SGDLearner(Learner):
                                   auc=prog.auc)
                 cur_part = part
             self._dispatch_item(job_type, item, push_cnt, want_counts, job,
-                                dim_min, pending)
-        self._merge_pending(pending, prog)
+                                dim_min, pending, cache=cache, part=cur_part)
+        self._final_merge(job_type, pending, prog)
         self._report_part(job_type, before, prog)
+        if cache is not None:
+            cache.finish_pass()
+
+    def _dispatch_packed(self, job_type: int, payload, pending: list,
+                         label=None) -> None:
+        """Run the fused step on an already-staged packed batch. ``payload``
+        = (layout, i32_dev, f32_dev, b_cap, dim2, u_cap, want_counts,
+        binary, has_rm, nrows); dim2 is the panel width or the COO nnz_cap."""
+        (layout, i32, f32, b_cap, d2, u_cap, want_counts, binary, has_rm,
+         nrows) = payload
+        is_train = job_type == K_TRAINING
+        if layout == "panel":
+            if is_train:
+                self.store.state, objv, auc = self._packed_panel_train(
+                    self.store.state, i32, f32, b_cap, d2, u_cap,
+                    want_counts, binary, has_rm)
+            else:
+                pred, objv, auc = self._packed_panel_eval(
+                    self.store.state, i32, f32, b_cap, d2, u_cap, binary,
+                    has_rm)
+        else:
+            if is_train:
+                self.store.state, objv, auc = self._packed_train(
+                    self.store.state, i32, f32, b_cap, d2, u_cap,
+                    want_counts, binary, has_rm)
+            else:
+                pred, objv, auc = self._packed_eval(
+                    self.store.state, i32, f32, b_cap, d2, u_cap, binary,
+                    has_rm)
+        if job_type == K_PREDICTION and self.param.pred_out:
+            self._save_pred(np.asarray(pred)[:nrows], label)
+        pending.append((nrows, objv, auc))
 
     def _dispatch_item(self, job_type: int, item, push_cnt: bool,
                        want_counts: bool, job: str, dim_min: int,
-                       pending: list) -> None:
+                       pending: list,
+                       cache: Optional[_DeviceBatchCache] = None,
+                       part: int = 0) -> None:
         """Consume one produced batch: stage + run the fused device step.
         ``want_counts``/``job`` arrive from _iterate_parts so producer-side
         packing and this consumer agree on the run-stable has_cnt static
@@ -754,33 +1012,23 @@ class SGDLearner(Learner):
         kind, blk, payload = item
         is_train = job_type == K_TRAINING
         if kind == "ready":
-            layout = payload[0]
-            if layout == "panel":
-                _, i32, f32, binary, b_cap, width, u_cap, has_rm = payload
-                i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
-                if is_train:
-                    self.store.state, objv, auc = \
-                        self._packed_panel_train(
-                            self.store.state, i32, f32, b_cap, width,
-                            u_cap, want_counts, binary, has_rm)
-                else:
-                    pred, objv, auc = self._packed_panel_eval(
-                        self.store.state, i32, f32, b_cap, width,
-                        u_cap, binary, has_rm)
-            else:
-                _, i32, f32, binary, b_cap, nnz_cap, u_cap, has_rm = payload
-                i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
-                if is_train:
-                    self.store.state, objv, auc = self._packed_train(
-                        self.store.state, i32, f32, b_cap, nnz_cap,
-                        u_cap, want_counts, binary, has_rm)
-                else:
-                    pred, objv, auc = self._packed_eval(
-                        self.store.state, i32, f32, b_cap, nnz_cap,
-                        u_cap, binary, has_rm)
-            if job_type == K_PREDICTION and p.pred_out:
-                self._save_pred(np.asarray(pred)[:blk.size], blk.label)
-            pending.append((blk.size, objv, auc))
+            layout, i32, f32, binary, b_cap, d2, u_cap, has_rm = payload
+            i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
+            wc = want_counts if is_train else False
+            dev_payload = (layout, i32, f32, b_cap, d2, u_cap, wc, binary,
+                           has_rm, blk.size)
+            self._dispatch_packed(job_type, dev_payload, pending,
+                                  label=blk.label)
+            if cache is not None and cache.alive:
+                # keep the staged buffers for HBM replay; the counts tail
+                # (epoch-0 feature-count push) is zeroed on device so a
+                # replayed step never re-counts
+                if wc and push_cnt:
+                    f32 = self._zero_counts(f32, u_cap)
+                cache.add(part,
+                          (layout, i32, f32, b_cap, d2, u_cap, wc, binary,
+                           has_rm, blk.size),
+                          i32.nbytes + f32.nbytes)
             return
 
         cblk, uniq, cnts = payload

@@ -218,8 +218,17 @@ class SlotStore:
             raise ValueError(f"unknown val_type {val_type}")
 
     def evaluate(self) -> Tuple[float, float]:
-        penalty, nnz = self.fns.evaluate(self.state)
+        penalty, nnz = self.evaluate_dev()
         return float(penalty), float(nnz)
+
+    def evaluate_dev(self):
+        """(penalty, nnz) as DEVICE scalars — callers batch the fetch with
+        other pending metrics (a sync fetch costs a full RTT on tunneled
+        chips, docs/perf_notes.md)."""
+        if not hasattr(self, "_eval_jit"):
+            import jax
+            self._eval_jit = jax.jit(self.fns.evaluate)
+        return self._eval_jit(self.state)
 
     # ------------------------------------------------------------- ckpt
     def _sorted_items(self) -> Tuple[np.ndarray, np.ndarray]:
