@@ -95,7 +95,15 @@ def main(argv: list[str] | None = None) -> int:
             remain.append(("task", "2"))
         remain = learner.init(remain)
         warn_unknown(remain)
-        learner.run()
+        from .parallel.fault import EXIT_PEER_DEAD, HostFailure
+        try:
+            learner.run()
+        except HostFailure as e:
+            # a peer host died; exit with the recovery code so the
+            # launcher (launch.py --max-restarts) evicts it and resumes
+            # from the last checkpoint (parallel/fault.py)
+            log.error("aborting for restart: %s", e)
+            return EXIT_PEER_DEAD
     elif param.task == "dump":
         warn_unknown(run_dump(remain))
     elif param.task == "convert":

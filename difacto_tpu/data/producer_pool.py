@@ -14,18 +14,28 @@ Memory is bounded: each part queue holds <= depth items and a worker blocks
 once its queue fills, so at most (workers + completed-but-unconsumed parts)
 x depth batches are in flight.
 
-A worker that raises re-queues its part via ``pool.reset`` (the dead-node
-path, workload_pool.h:88-105) so another worker can retry it; the retry
-skips the items the failed attempt already enqueued, and the error is
-re-raised to the consumer only if the part keeps failing (max_retries).
+Failure and straggler handling (workload_pool.h:88-105, 155-176):
+
+- a worker that RAISES re-queues its part via ``pool.reset`` so another
+  worker retries it, escalating to the consumer after ``max_retries``;
+- a part STUCK on a worker (hung IO) is re-issued by ``remove_stragglers``
+  — idle workers poll it, so a straggling part is reclaimed as soon as the
+  pool's 10x-mean criterion trips.
+
+Both paths deliver every item exactly once through a per-part GENERATION:
+taking a part bumps its generation and snapshots the delivered-item count
+(both under the part lock); every enqueue re-checks the generation, so a
+superseded attempt — failed, stalled-then-woken, or raced — abandons
+instead of double-delivering, and the new attempt resumes exactly after
+the items already enqueued.
 
 **API contract: ``make_iter(part)`` MUST be deterministic** — calling it
-twice for the same part must yield the same item sequence, because the
-retry path resumes via ``islice(make_iter(part), n_delivered)``. A
+twice for the same part must yield the same item sequence, because retries
+and re-issues resume via ``islice(make_iter(part), n_delivered)``. A
 nondeterministic iterator (unseeded shuffle, IO-dependent chunking) would
-silently skip or duplicate batches on retry. The learner satisfies this by
-seeding its shuffle/sampling streams per (epoch, part)
-(learners/sgd.py _make_reader).
+silently skip or duplicate batches. The learner satisfies this by seeding
+its shuffle/sampling streams per (epoch, part) (learners/sgd.py
+_make_reader).
 """
 
 from __future__ import annotations
@@ -61,19 +71,38 @@ class OrderedProducerPool:
         self._errors: list = []
         self._fail_counts = [0] * n_parts
         self._enqueued = [0] * n_parts  # items already delivered per part
+        self._gen = [0] * n_parts       # per-part attempt generation
+        self._locks = [threading.Lock() for _ in range(n_parts)]
         self._threads = [
             threading.Thread(target=self._work, args=(w,), daemon=True)
             for w in range(self.n_workers)
         ]
 
-    def _put(self, part: int, item) -> bool:
-        while not self._stop.is_set():
-            try:
-                self._queues[part].put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
+    def _deliver(self, part: int, node: int, my_gen: int, item) -> str:
+        """Enqueue under the generation guard: 'ok', 'superseded' (another
+        attempt took over this part) or 'stopped'.
+
+        The part lock is held only for the non-blocking enqueue + count
+        update (the exactly-once critical section) — never across a wait.
+        While back-pressured on a full queue we wait OUTSIDE the lock and
+        ``touch`` the pool, so (a) a replacement worker is never parked on
+        the lock and (b) a healthy, merely-blocked part does not trip the
+        straggler criterion."""
+        while True:
+            with self._locks[part]:
+                if self._gen[part] != my_gen:
+                    return "superseded"
+                try:
+                    self._queues[part].put_nowait(item)
+                    if item is not _END:
+                        self._enqueued[part] += 1
+                    return "ok"
+                except queue.Full:
+                    pass
+            if self._stop.is_set():
+                return "stopped"
+            self.pool.touch(node)
+            time.sleep(0.05)
 
     def _work(self, node: int) -> None:
         while not self._stop.is_set():
@@ -81,27 +110,43 @@ class OrderedProducerPool:
             if part == -2:
                 if self.pool.num_remains() == 0:
                     return
-                time.sleep(0.02)  # a failed part may be re-queued
+                # idle workers double as the straggler poller (the
+                # reference used a 2 s monitor thread,
+                # workload_pool.h:155-176); a re-queued part is picked up
+                # by the next get()
+                self.pool.remove_stragglers()
+                time.sleep(0.02)
                 continue
+            with self._locks[part]:
+                # supersede any earlier (stalled) attempt and resume after
+                # the items it already delivered
+                self._gen[part] += 1
+                my_gen = self._gen[part]
+                start = self._enqueued[part]
             try:
-                # a retry resumes after the items the failed attempt already
-                # enqueued (deterministic per-part iteration)
-                it = itertools.islice(self.make_iter(part),
-                                      self._enqueued[part], None)
+                it = itertools.islice(self.make_iter(part), start, None)
+                abandoned = False
                 for item in it:
-                    if not self._put(part, item):
+                    st = self._deliver(part, node, my_gen, item)
+                    if st == "superseded":
+                        abandoned = True
+                        break
+                    if st == "stopped":
                         self.pool.reset(node)
                         return
-                    self._enqueued[part] += 1
-                if not self._put(part, _END):
+                if abandoned:
+                    continue  # re-issued elsewhere; not ours to finish
+                st = self._deliver(part, node, my_gen, _END)
+                if st == "stopped":
                     self.pool.reset(node)
                     return
-                self.pool.finish(node)
+                if st == "ok":
+                    self.pool.finish(node)
             except BaseException as e:  # re-queue, escalate if persistent
                 self._fail_counts[part] += 1
                 if self._fail_counts[part] > self.max_retries:
                     self._errors.append(e)
-                    self._put(part, _END)
+                    self._deliver(part, node, my_gen, _END)
                     self.pool.finish(node)
                 else:
                     self.pool.reset(node)

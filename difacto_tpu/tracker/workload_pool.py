@@ -110,6 +110,16 @@ class WorkloadPool:
                     log.info("%d failed to finish part %d", node, a.part)
             self._assigned = rest
 
+    def touch(self, node: int) -> None:
+        """Refresh the assignment clocks of ``node``'s in-flight parts.
+        Producers call this while back-pressured (blocked on a full consumer
+        queue), so ``remove_stragglers`` measures *stall* time — a healthy
+        part waiting for the consumer is not a straggler."""
+        with self._mu:
+            now = _time.time()
+            self._assigned = [a._replace(start=now) if a.node == node else a
+                              for a in self._assigned]
+
     def num_remains(self) -> int:
         """Unfinished parts: available + in-flight, each counted once."""
         with self._mu:

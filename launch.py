@@ -1,16 +1,26 @@
 #!/usr/bin/env python
-"""Multi-process launcher — the dmlc-tracker equivalent.
+"""Multi-process launcher — the dmlc-tracker equivalent, with supervision.
 
 The reference submits scheduler/server/worker processes via dmlc-tracker
-(launch.py:32-78, run_local/ssh/yarn.sh). The TPU framework is
+(launch.py:32-78, run_local/ssh/yarn.sh) and its DistTracker reassigns a
+dead node's work (src/tracker/dist_tracker.h:164-186). The TPU framework is
 multi-controller SPMD: every process runs the SAME program; this launcher
 starts ``-n`` local processes with the rendezvous env
 (DIFACTO_COORDINATOR/NPROCS/RANK -> jax.distributed.initialize, see
 difacto_tpu/parallel/multihost.py). On a real TPU pod each host's runtime
 (GKE/xpk/ray) sets the equivalent variables instead.
 
+``--max-restarts k`` adds the recovery loop of the dead-host protocol
+(difacto_tpu/parallel/fault.py): heartbeat env is exported so workers
+detect peer death and abort instead of hanging; when any process fails,
+the launcher kills the stragglers, EVICTS one host (local stand-in for
+"the dead machine is gone"), and relaunches the survivors — byte-range
+input sharding re-partitions the data over them and training resumes from
+the last epoch checkpoint (SGDLearner ckpt_interval/auto_resume).
+
 Usage:
     python launch.py -n 2 -- python -m difacto_tpu train.conf k=v ...
+    python launch.py -n 2 --max-restarts 1 -- python -m difacto_tpu ...
 """
 
 from __future__ import annotations
@@ -19,12 +29,66 @@ import argparse
 import os
 import subprocess
 import sys
+import time
+
+
+def _spawn(cmd, n, port, attempt, args):
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update(
+            DIFACTO_COORDINATOR=f"127.0.0.1:{port}",
+            DIFACTO_NPROCS=str(n),
+            DIFACTO_RANK=str(rank),
+            DIFACTO_RESTART=str(attempt),
+        )
+        if args.max_restarts > 0:
+            env.update(
+                DIFACTO_HB_PORT=str(args.hb_port + 64 * attempt),
+                DIFACTO_HB_TIMEOUT=str(args.hb_timeout),
+            )
+        procs.append(subprocess.Popen(cmd, env=env))
+    return procs
+
+
+def _run_once(cmd, n, port, attempt, args) -> int:
+    """0 = all exited cleanly; else the first nonzero rc (stragglers are
+    killed: a failed peer leaves them blocked or doomed to abort)."""
+    procs = _spawn(cmd, n, port, attempt, args)
+    try:
+        while True:
+            rcs = [p.poll() for p in procs]
+            bad = [rc for rc in rcs if rc not in (None, 0)]
+            if bad:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                for p in procs:
+                    p.wait()
+                return bad[0]
+            if all(rc == 0 for rc in rcs):
+                return 0
+            time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--num-processes", type=int, default=1)
     ap.add_argument("--port", type=int, default=7799)
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="recovery attempts after a host failure: evict "
+                         "one host, relaunch survivors, resume from the "
+                         "last checkpoint (needs ckpt_interval + "
+                         "auto_resume in the trained config)")
+    ap.add_argument("--hb-port", type=int, default=29800,
+                    help="UDP heartbeat base port (rank i binds base+i)")
+    ap.add_argument("--hb-timeout", type=float, default=5.0,
+                    help="seconds of heartbeat silence before a peer is "
+                         "declared dead")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="command to launch (prefix with --)")
     args = ap.parse_args()
@@ -32,18 +96,31 @@ def main() -> int:
     if not cmd:
         ap.error("no command given")
 
-    procs = []
-    for rank in range(args.num_processes):
-        env = dict(os.environ)
-        env.update(
-            DIFACTO_COORDINATOR=f"127.0.0.1:{args.port}",
-            DIFACTO_NPROCS=str(args.num_processes),
-            DIFACTO_RANK=str(rank),
-        )
-        procs.append(subprocess.Popen(cmd, env=env))
+    n = args.num_processes
     rc = 0
-    for p in procs:
-        rc |= p.wait()
+    for attempt in range(args.max_restarts + 1):
+        # fresh rendezvous + heartbeat ports per attempt: the previous
+        # coordinator socket may linger in TIME_WAIT
+        rc = _run_once(cmd, n, args.port + 7 * attempt, attempt, args)
+        if rc == 0:
+            return 0
+        if attempt == args.max_restarts:
+            break
+        # only host-death exits are recoverable: EXIT_PEER_DEAD (a survivor
+        # noticed a dead peer) or signal death (negative rc = the "dead
+        # host" itself). A deterministic config/user error would fail
+        # identically on every shrinking relaunch — surface it instead.
+        try:
+            from difacto_tpu.parallel.fault import EXIT_PEER_DEAD
+        except ImportError:  # launched from outside the repo
+            EXIT_PEER_DEAD = 42
+        if rc != EXIT_PEER_DEAD and rc >= 0:
+            print(f"[launch] attempt {attempt} failed with non-recovery "
+                  f"rc={rc}; not restarting", file=sys.stderr)
+            break
+        n = max(1, n - 1)
+        print(f"[launch] attempt {attempt} failed (rc={rc}); evicting one "
+              f"host, relaunching {n} process(es)", file=sys.stderr)
     return rc
 
 

@@ -1,0 +1,79 @@
+"""Fault-injection worker for tests/test_fault.py, run through
+launch.py --max-restarts 1 with 2 processes.
+
+On the first attempt (DIFACTO_RESTART=0), rank 1 kills itself (os._exit)
+in the MIDDLE of epoch 1 — at its 4th DCN allgather, i.e. after epoch 1's
+training batch but before the epoch's termination round — simulating a
+dead host. The survivor's heartbeat watchdog must abort its blocked
+collective (exit 42), after which the launcher evicts a host and
+relaunches a single process that auto-resumes from the epoch-0 checkpoint
+and finishes the run over ALL the data (byte-range re-sharding).
+
+Usage: fault_worker.py <out_dir> <data_path> [epochs]
+"""
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from difacto_tpu.parallel.multihost import initialize  # noqa: E402
+
+initialize()
+
+attempt = os.environ.get("DIFACTO_RESTART", "0")
+rank = jax.process_index()
+
+if rank == 1 and attempt == "0":
+    import difacto_tpu.parallel.multihost as mh
+    _orig, _calls = mh.allgather_np, {"n": 0}
+
+    def _dying_allgather(arr):
+        _calls["n"] += 1
+        if _calls["n"] == 4:  # epoch 1, after its train batch: mid-epoch
+            print(f"rank {rank}: simulating host death", flush=True)
+            # die by signal, like a real dead host (OOM-kill / machine
+            # loss); the launcher only restarts on signal death or
+            # EXIT_PEER_DEAD — a plain rc=1 is a config error, not a fault
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+        return _orig(arr)
+
+    mh.allgather_np = _dying_allgather
+
+from difacto_tpu.learners import Learner  # noqa: E402
+
+out_dir, data = sys.argv[1], sys.argv[2]
+epochs = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+
+nprocs = jax.process_count()
+ln = Learner.create("sgd")
+ln.init([("data_in", data), ("V_dim", "2"), ("V_threshold", "2"),
+         ("lr", "0.1"), ("l1", "0.1"), ("l2", "0"),
+         ("batch_size", "100"), ("max_num_epochs", str(epochs)),
+         ("shuffle", "0"), ("report_interval", "0"),
+         ("stop_rel_objv", "0"), ("stop_val_auc", "-2"),
+         ("num_jobs_per_epoch", "1"),
+         ("hash_capacity", str(1 << 20)),
+         ("mesh_dp", str(nprocs)), ("mesh_fs", "4"),
+         ("ckpt_interval", "1"), ("auto_resume", "1"),
+         ("model_out", os.path.join(out_dir, "model"))])
+seen = []
+ln.add_epoch_end_callback(lambda e, t, v: seen.append((e, t.loss)))
+
+from difacto_tpu.parallel.fault import EXIT_PEER_DEAD, HostFailure  # noqa
+
+try:
+    ln.run()
+except HostFailure as e:
+    print(f"rank {rank}: {e}", flush=True)
+    sys.exit(EXIT_PEER_DEAD)
+
+with open(os.path.join(out_dir, f"traj-{rank}.json"), "w") as f:
+    json.dump({"epochs": seen, "attempt": int(attempt),
+               "nprocs": nprocs}, f)
+print(f"rank {rank} done (attempt {attempt}): {seen}")
