@@ -104,20 +104,38 @@ class DataStore:
             self._spilled[key] = path
 
 
+def _leaf_nbytes(tile: Any) -> int:
+    """Total bytes of a tile's array leaves (device or host)."""
+    if tile is None:
+        return 0
+    import jax
+    return sum(int(getattr(x, "nbytes", 0))
+               for x in jax.tree_util.tree_leaves(tile))
+
+
 class TileCache:
     """LRU cache of built tiles keyed by (rowblk_id, colblk_id).
 
     ``build(rowblk_id, colblk_id)`` constructs a tile (host or device
-    object); ``max_items=0`` means unlimited. ``None`` results (empty
-    tiles) are cached too.
+    object). Two independent bounds, both 0 = unlimited: ``max_items``
+    (count) and ``max_bytes`` (sum of leaf array bytes via ``sizeof``,
+    default: jax-tree nbytes) — the byte bound is what caps device
+    memory for feature-blocked learners on > HBM datasets (the
+    reference's analog: TileStore's cache over DataStore,
+    src/data/tile_store.h:32-168). At least one entry always stays
+    resident. ``None`` results (empty tiles) are cached too.
     """
 
     def __init__(self, build: Callable[[Hashable, Hashable], Any],
-                 max_items: int = 0):
+                 max_items: int = 0, max_bytes: int = 0,
+                 sizeof: Optional[Callable[[Any], int]] = None):
         self._build = build
-        self._cache: "OrderedDict[Tuple[Hashable, Hashable], Any]" \
-            = OrderedDict()
+        self._cache: "OrderedDict[Tuple[Hashable, Hashable], " \
+            "Tuple[Any, int]]" = OrderedDict()
         self.max_items = max_items
+        self.max_bytes = max_bytes
+        self._sizeof = sizeof or _leaf_nbytes
+        self._bytes = 0
         self.hits = 0
         self.misses = 0
 
@@ -126,12 +144,17 @@ class TileCache:
         if key in self._cache:
             self._cache.move_to_end(key)
             self.hits += 1
-            return self._cache[key]
+            return self._cache[key][0]
         self.misses += 1
         tile = self._build(rowblk_id, colblk_id)
-        self._cache[key] = tile
-        if self.max_items and len(self._cache) > self.max_items:
-            self._cache.popitem(last=False)
+        sz = self._sizeof(tile)
+        self._cache[key] = (tile, sz)
+        self._bytes += sz
+        while len(self._cache) > 1 and (
+                (self.max_items and len(self._cache) > self.max_items)
+                or (self.max_bytes and self._bytes > self.max_bytes)):
+            _, (_, esz) = self._cache.popitem(last=False)
+            self._bytes -= esz
         return tile
 
     def prefetch(self, rowblk_id: Hashable, colblk_id: Hashable) -> None:
@@ -139,6 +162,11 @@ class TileCache:
 
     def invalidate(self) -> None:
         self._cache.clear()
+        self._bytes = 0
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
 
     def __len__(self) -> int:
         return len(self._cache)

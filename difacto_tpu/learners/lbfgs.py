@@ -72,6 +72,18 @@ class LBFGSLearnerParam(Param):
     gamma: float = 1.0
     max_num_linesearchs: int = 5
     num_threads: int = 0  # accepted for config parity; XLA owns threading
+    # shard the flat [w, V...] vector (and every grad/direction/s/y vector)
+    # over an fs-axis device mesh — the TPU analog of the reference's
+    # key-range server sharding for L-BFGS (lbfgs_updater.h:45-56): the
+    # 6m+1 Gram inner products the reference allreduces across servers
+    # (SendJobAndWait vector-add, src/common/learner_utils.h:21-51) become
+    # XLA psums over the sharded axis. 1 = single device.
+    mesh_fs: int = 1
+    # cap on HBM held by device tiles (0 = keep every tile resident, the
+    # round-3 behavior); evicted tiles rebuild on demand from the host
+    # blocks (the reference streams tiles from TileStore/DataStore,
+    # src/lbfgs/lbfgs_learner.cc:237-291; round-3 verdict #7)
+    tile_cache_mb: int = 1024
 
 
 @dataclass
@@ -121,8 +133,24 @@ class LBFGSLearner(Learner):
         if self.param.loss == "logit":
             self.uparam = dataclasses.replace(self.uparam, V_dim=0)
         self.k = self.uparam.V_dim
+        self.mesh = None
+        if self.param.mesh_fs > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..parallel import make_mesh
+            from ..parallel.mesh import FS_AXIS
+            self.mesh = make_mesh(dp=1, fs=self.param.mesh_fs)
+            self._vec_shard = NamedSharding(self.mesh,
+                                            PartitionSpec(FS_AXIS))
+            from ..parallel import replicated
+            self._repl = replicated(self.mesh)
         self._build_steps()
         return remain
+
+    def _put_vec(self, arr) -> jnp.ndarray:
+        """Place a flat-layout vector: fs-sharded under a mesh, else local."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        return jax.device_put(jnp.asarray(arr), self._vec_shard)
 
     def set_weight_initializer(self, fn: Callable) -> None:
         """fn(lens: int32[n_feat], weights: f32[N]) -> f32[N] — the
@@ -178,7 +206,7 @@ class LBFGSLearner(Learner):
         self.N = int(offsets[-1])
         # trailing trash/pad region; last V_dim+1 slots reserved so trash
         # V rows stay in bounds
-        self.N_pad = bucket(self.N + up.V_dim + 1)
+        self.N_pad = bucket(self.N + up.V_dim + 1, self._dim_min())
         self.trash_w = self.N_pad - 1
         self.trash_v = self.N_pad - 1 - up.V_dim
 
@@ -196,12 +224,34 @@ class LBFGSLearner(Learner):
             w[:self.N] = np.where(is_w, 0.0, vals)
 
         self._refresh_layout_constants()
-        self.weights = jnp.asarray(w)
+        self.weights = self._put_vec(w)
 
-        self.train_tiles = [self._build_tile(cb, u)
-                            for cb, u in self._raw_train]
-        self.val_tiles = [self._build_tile(cb, u) for cb, u in self._raw_val]
-        del self._raw_train, self._raw_val
+        self._n_tiles = {"train": len(self._raw_train),
+                         "val": len(self._raw_val)}
+        if self.param.tile_cache_mb > 0:
+            # bounded HBM: device tiles live in a byte-budgeted LRU and
+            # rebuild from the kept host blocks on miss
+            from ..data.tile_store import TileCache
+            self._tile_cache = TileCache(
+                lambda which, i: self._build_tile(
+                    *(self._raw_train if which == "train"
+                      else self._raw_val)[i]),
+                max_bytes=self.param.tile_cache_mb << 20)
+        else:
+            self._tile_cache = None
+            self._res_tiles = {
+                "train": [self._build_tile(cb, u)
+                          for cb, u in self._raw_train],
+                "val": [self._build_tile(cb, u) for cb, u in self._raw_val],
+            }
+            del self._raw_train, self._raw_val
+
+    def _iter_tiles(self, which: str):
+        if self._tile_cache is None:
+            yield from self._res_tiles[which]
+            return
+        for i in range(self._n_tiles[which]):
+            yield self._tile_cache.fetch(which, i)
 
     def _refresh_layout_constants(self) -> None:
         """(Re)derive the device constants tied to the flat layout: the
@@ -212,8 +262,15 @@ class LBFGSLearner(Learner):
         c = np.zeros(self.N_pad, dtype=np.float32)
         c[:self.N] = self.uparam.V_l2
         c[self.offsets[:-1]] = self.uparam.l2
-        self.reg_c = jnp.asarray(c)
+        self.reg_c = self._put_vec(c)
         self._n_real = jnp.asarray(self.N, dtype=jnp.int32)
+
+    def _dim_min(self) -> int:
+        """Bucket floor for the flat vector: divisible by the fs axis."""
+        if self.mesh is None:
+            return 8
+        from ..ops.batch import mesh_dim_min
+        return mesh_dim_min(self.param.mesh_fs)
 
     def _warm_start(self, path: str) -> int:
         """Copy checkpoint weights into the current layout (model_in warm
@@ -236,7 +293,7 @@ class LBFGSLearner(Learner):
         dst_idx = expand_ranges(self.offsets[:-1][ok], lens)
         w = np.asarray(self.weights).copy()
         w[dst_idx] = ck_w[src_idx]
-        self.weights = jnp.asarray(w)
+        self.weights = self._put_vec(w)
         return int(ok.sum())
 
     def _build_tile(self, cblk, uniq: np.ndarray) -> Tile:
@@ -259,7 +316,7 @@ class LBFGSLearner(Learner):
             out[:len(a)] = a
             return out
 
-        return Tile(
+        tile = Tile(
             batch=batch,
             w_pos=jnp.asarray(pad(w_pos.astype(np.int32),
                                   np.int32(self.trash_w))),
@@ -267,6 +324,13 @@ class LBFGSLearner(Learner):
                                   np.int32(self.trash_v))),
             v_mask=jnp.asarray(pad(has_v.astype(np.float32), np.float32(0))),
         )
+        if self.mesh is not None:
+            # tiles ride replicated over the mesh; only the flat vector is
+            # fs-sharded, so the tile gathers/scatters become the XLA
+            # collectives of the Push/Pull (SURVEY §7 step 7)
+            tile = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, self._repl), tile)
+        return tile
 
     # ----------------------------------------------------------- jit steps
     def _build_steps(self) -> None:
@@ -330,10 +394,10 @@ class LBFGSLearner(Learner):
 
     def _calc_grad(self, weights):
         """f(w), train auc, loss gradient — one pass over train tiles."""
-        grad = jnp.zeros(self.N_pad, dtype=jnp.float32)
+        grad = self._put_vec(jnp.zeros(self.N_pad, dtype=jnp.float32))
         objv = 0.0
         auc = 0.0
-        for tile in self.train_tiles:
+        for tile in self._iter_tiles("train"):
             o, a, grad = self._tile_grad(weights, grad, tile)
             objv += float(o)
             auc += float(a)
@@ -424,7 +488,7 @@ class LBFGSLearner(Learner):
 
             # kEvaluate (lbfgs_learner.cc:72-84)
             val_auc = 0.0
-            for tile in self.val_tiles:
+            for tile in self._iter_tiles("val"):
                 val_auc += float(self._tile_pred_auc(self.weights, tile))
             prog = LBFGSProgress(
                 objv=new_objv,
@@ -481,10 +545,10 @@ class LBFGSLearner(Learner):
         np.cumsum(self.lens, out=offsets[1:])
         self.offsets = offsets
         self.N = int(offsets[-1])
-        self.N_pad = bucket(self.N + self.k + 1)
+        self.N_pad = bucket(self.N + self.k + 1, self._dim_min())
         self.trash_w = self.N_pad - 1
         self.trash_v = self.N_pad - 1 - self.k
         buf = np.zeros(self.N_pad, dtype=np.float32)
         buf[:self.N] = w
-        self.weights = jnp.asarray(buf)
+        self.weights = self._put_vec(buf)
         self._refresh_layout_constants()

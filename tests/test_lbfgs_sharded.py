@@ -1,0 +1,89 @@
+"""Mesh-sharded L-BFGS (round-3 verdict #6): the flat [w, V...] vector —
+and with it every gradient, direction and s/y history vector — is sharded
+over an 8-device fs mesh; the 6m+1 Gram inner products become XLA psums
+(the reference allreduced them across servers via SendJobAndWait,
+src/common/learner_utils.h:21-51, src/lbfgs/lbfgs_updater.h:84-121).
+
+The golden trajectories must be REPRODUCED, not approximated: sharding a
+reduction changes the machine, not the math (fp summation order may differ
+at 1e-7; the goldens tolerate 1e-5).
+"""
+
+import numpy as np
+import pytest
+
+from difacto_tpu.learners import Learner
+from tests.test_lbfgs import OBJV_BASIC, OBJV_WITHV
+
+
+def run_sharded(rcv1_path, **over):
+    args = {"data_in": rcv1_path, "m": "5", "V_dim": "0", "l2": "0",
+            "init_alpha": "1", "tail_feature_filter": "0",
+            "max_num_epochs": "19", "mesh_fs": "8"}
+    args.update({k: str(v) for k, v in over.items()})
+    learner = Learner.create("lbfgs")
+    remain = learner.init(list(args.items()))
+    assert remain == []
+    seen = []
+    learner.add_epoch_end_callback(lambda e, prog: seen.append(prog.objv))
+    learner.run()
+    return learner, np.array(seen)
+
+
+def _assert_actually_sharded(learner, n_dev=8):
+    w = learner.weights
+    devs = {s.device for s in w.addressable_shards}
+    assert len(devs) == n_dev
+    for s in w.addressable_shards:
+        assert s.data.shape[0] == w.shape[0] // n_dev
+
+
+def test_lbfgs_sharded_basic_golden(rcv1_path):
+    learner, seen = run_sharded(rcv1_path)
+    _assert_actually_sharded(learner)
+    err = np.abs(seen - np.array(OBJV_BASIC))
+    assert err.max() < 1e-5, list(zip(seen, OBJV_BASIC))
+
+
+def test_lbfgs_sharded_fm_golden(rcv1_path):
+    """The FM (V_dim=5) trajectory with the deterministic initializer,
+    sharded (tests/cpp/lbfgs_learner_test.cc:88-146; tolerance rationale in
+    tests/test_lbfgs.py test_lbfgs_withv_golden)."""
+    args = {"data_in": rcv1_path, "m": "5", "V_dim": "5", "l2": "0.1",
+            "V_l2": "0.01", "V_threshold": "0", "rho": "0.5",
+            "init_alpha": "1", "tail_feature_filter": "0",
+            "max_num_epochs": str(len(OBJV_WITHV)), "mesh_fs": "8"}
+    learner = Learner.create("lbfgs")
+    assert learner.init(list(args.items())) == []
+
+    def initializer(lens, weights):
+        # (lbfgs_learner_test.cc:128-140): V[j] = (j - V_dim/2) * .01
+        n = 0
+        for l in lens:
+            for i in range(l):
+                if i > 0:
+                    weights[n] = (i - (l - 1) / 2) * 0.01
+                n += 1
+        return weights
+
+    learner.set_weight_initializer(initializer)
+    seen = []
+    learner.add_epoch_end_callback(lambda e, prog: seen.append(prog.objv))
+    learner.run()
+    _assert_actually_sharded(learner)
+    err = np.abs(np.array(seen) - np.array(OBJV_WITHV))
+    assert err.max() < 2e-4, list(zip(seen, OBJV_WITHV))
+
+
+def test_lbfgs_sharded_ckpt_roundtrip(rcv1_path, tmp_path):
+    """Sharded save -> load -> identical weights and re-sharded layout."""
+    learner, _ = run_sharded(rcv1_path, max_num_epochs="3",
+                             model_out=str(tmp_path / "m"))
+    w0 = np.asarray(learner.weights)
+    other = Learner.create("lbfgs")
+    other.init([("data_in", rcv1_path), ("m", "5"), ("V_dim", "0"),
+                ("l2", "0"), ("mesh_fs", "8")])
+    other.load(str(tmp_path / "m"))
+    _assert_actually_sharded(other)
+    np.testing.assert_allclose(np.asarray(other.weights)[:other.N],
+                               w0[:learner.N])
