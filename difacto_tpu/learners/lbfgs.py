@@ -496,7 +496,11 @@ class LBFGSLearner(Learner):
                 val_auc=val_auc / self.nval if self.nval else 0.0,
                 nnz_w=float(self._nnz(self.weights)),
             )
-            log.info(" - training AUC = %g", prog.auc)
+            if self.nval:
+                log.info(" - training AUC = %g, validation AUC = %g",
+                         prog.auc, prog.val_auc)
+            else:
+                log.info(" - training AUC = %g", prog.auc)
             for cb in self.epoch_end_callbacks:
                 cb(epoch, prog)
 
