@@ -88,3 +88,46 @@ def parse_criteo_native(chunk: bytes, is_train: bool = True) -> RowBlock:
         index=index[:nnz].copy(),
         value=None,  # binary features
     )
+
+
+def parse_adfea_native(chunk: bytes) -> RowBlock:
+    """Native adfea parser with Python fallback (parsers.py:parse_adfea is
+    the semantic reference; src/reader/adfea_parser.h:20-91)."""
+    lib = get_lib()
+    if lib is None:
+        from .parsers import parse_adfea
+        return parse_adfea(chunk)
+
+    # every feature token contains ':'; rows are delimited by their 3
+    # header tokens, so splitting on whitespace bounds rows loosely
+    max_nnz = chunk.count(b":") + 1
+    # tokens are separated by >= 1 whitespace char and each row owns 3
+    # header tokens, so rows <= (separators + 1) / 3; count every
+    # separator class the native tokenizer skips (incl. '\r' — an
+    # undercount here overruns the caller-allocated buffers)
+    seps = (chunk.count(b"\n") + chunk.count(b" ") + chunk.count(b"\t")
+            + chunk.count(b"\r"))
+    max_rows = seps // 3 + 2
+    labels = np.empty(max_rows, dtype=REAL_DTYPE)
+    offset = np.empty(max_rows + 1, dtype=np.int64)
+    index = np.empty(max_nnz, dtype=FEAID_DTYPE)
+    out_rows = ctypes.c_int64()
+    out_nnz = ctypes.c_int64()
+
+    rc = lib.difacto_parse_adfea(
+        chunk, len(chunk),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        offset.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        index.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.byref(out_rows), ctypes.byref(out_nnz))
+    if rc != 0:
+        raise ValueError("malformed adfea chunk")
+    n, nnz = out_rows.value, out_nnz.value
+    if n == 0:
+        return empty_block()
+    return RowBlock(
+        offset=offset[:n + 1].copy(),
+        label=labels[:n].copy(),
+        index=index[:nnz].copy(),
+        value=None,  # binary features
+    )

@@ -176,5 +176,6 @@ def get_parser(fmt: str):
         from .native_parsers import parse_criteo_native
         return lambda chunk: parse_criteo_native(chunk, is_train=False)
     if fmt == "adfea":
-        return parse_adfea
+        from .native_parsers import parse_adfea_native
+        return parse_adfea_native
     raise ValueError(f"unknown data format: {fmt}")

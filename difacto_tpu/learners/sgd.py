@@ -330,6 +330,44 @@ class SGDLearner(Learner):
                                            static_argnums=(3, 4, 5, 6, 7, 8))
         self._packed_panel_eval = jax.jit(packed_panel_eval,
                                           static_argnums=(3, 4, 5, 6, 7))
+
+        # sorted-token variant for cached replays: the backward's unsorted
+        # [B*F, k+2] scatter becomes a sorted segment reduction (1.43x at
+        # bench shapes, docs/perf_notes.md). The token order is computed on
+        # device ONCE at staging time (_panel_sort_packed) and replayed
+        # with the cached buffers — streaming epoch 0 keeps the unsorted
+        # step, so this adds exactly one extra compile per run.
+        def panel_sort_packed(i32, f32, b_cap, width, binary):
+            # the sorted arrays are staged PRECOMPUTED (sr+sl+sv): deriving
+            # them from the argsort order inside every replayed step was
+            # measured ~14 ms/step slower (it breaks XLA's fusion around
+            # the sorted scatter). Footprint: ~3x the packed i32 per
+            # cached train batch; a budget overflow degrades gracefully
+            # to streaming (cache.add kills the cache), so tight
+            # device_cache_mb budgets lose the replay, not correctness.
+            cells = b_cap * width
+            flat = i32[:cells]
+            order = jnp.argsort(flat)
+            sr = (order // width).astype(jnp.int32)
+            sl = flat[order]
+            return (sr, sl, None if binary else f32[:cells][order])
+
+        self._panel_sort_packed = jax.jit(panel_sort_packed,
+                                          static_argnums=(2, 3, 4))
+
+        def packed_panel_train_sorted(state, i32, f32, sr, sl, sv, b_cap,
+                                      width, u_cap, has_cnt, binary,
+                                      has_remap=False):
+            pb, slots, counts = unpack_panel(i32, f32, b_cap, width, u_cap,
+                                             has_cnt, binary, has_remap)
+            if counts is not None:
+                state = fns.apply_count(state, slots, counts)
+            pb = pb._replace(sorted_rows=sr, sorted_lane=sl, sorted_vals=sv)
+            return train_step(state, pb, slots)
+
+        self._packed_panel_train_sorted = jax.jit(
+            packed_panel_train_sorted, donate_argnums=0,
+            static_argnums=(6, 7, 8, 9, 10, 11))
         # device-side zeroing of the packed f32 counts tail: replayed cache
         # entries must not re-push epoch-0 feature counts
         self._zero_counts = jax.jit(
@@ -973,9 +1011,19 @@ class SGDLearner(Learner):
         """Run the fused step on an already-staged packed batch. ``payload``
         = (layout, i32_dev, f32_dev, b_cap, dim2, u_cap, want_counts,
         binary, has_rm, nrows); dim2 is the panel width or the COO nnz_cap."""
+        is_train = job_type == K_TRAINING
+        if payload[0] == "panel_sorted":
+            # cached replay fast path (train only): packed panel + the
+            # staged sorted-token order
+            (_, i32, f32, sr, sl, sv, b_cap, d2, u_cap, want_counts,
+             binary, has_rm, nrows) = payload
+            self.store.state, objv, auc = self._packed_panel_train_sorted(
+                self.store.state, i32, f32, sr, sl, sv, b_cap, d2, u_cap,
+                want_counts, binary, has_rm)
+            pending.append((nrows, objv, auc))
+            return
         (layout, i32, f32, b_cap, d2, u_cap, want_counts, binary, has_rm,
          nrows) = payload
-        is_train = job_type == K_TRAINING
         if layout == "panel":
             if is_train:
                 self.store.state, objv, auc = self._packed_panel_train(
@@ -1015,8 +1063,21 @@ class SGDLearner(Learner):
             layout, i32, f32, binary, b_cap, d2, u_cap, has_rm = payload
             i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
             wc = want_counts if is_train else False
-            dev_payload = (layout, i32, f32, b_cap, d2, u_cap, wc, binary,
-                           has_rm, blk.size)
+            staging = (cache is not None and cache.alive
+                       and layout == "panel" and is_train)
+            if staging:
+                # cache-eligible panel training: sort ONCE at staging time
+                # and dispatch epoch 0 through the SAME sorted step the
+                # replays use — one compiled train variant per run, and
+                # every epoch takes the sorted backward
+                # (docs/perf_notes.md)
+                sr, sl, sv = self._panel_sort_packed(i32, f32, b_cap, d2,
+                                                     binary)
+                dev_payload = ("panel_sorted", i32, f32, sr, sl, sv, b_cap,
+                               d2, u_cap, wc, binary, has_rm, blk.size)
+            else:
+                dev_payload = (layout, i32, f32, b_cap, d2, u_cap, wc,
+                               binary, has_rm, blk.size)
             self._dispatch_packed(job_type, dev_payload, pending,
                                   label=blk.label)
             if cache is not None and cache.alive:
@@ -1025,10 +1086,19 @@ class SGDLearner(Learner):
                 # replayed step never re-counts
                 if wc and push_cnt:
                     f32 = self._zero_counts(f32, u_cap)
-                cache.add(part,
-                          (layout, i32, f32, b_cap, d2, u_cap, wc, binary,
-                           has_rm, blk.size),
-                          i32.nbytes + f32.nbytes)
+                nbytes = i32.nbytes + f32.nbytes
+                if staging:
+                    nbytes += sr.nbytes + sl.nbytes + (
+                        0 if sv is None else sv.nbytes)
+                    cache.add(part,
+                              ("panel_sorted", i32, f32, sr, sl, sv, b_cap,
+                               d2, u_cap, wc, binary, has_rm, blk.size),
+                              nbytes)
+                else:
+                    cache.add(part,
+                              (layout, i32, f32, b_cap, d2, u_cap, wc,
+                               binary, has_rm, blk.size),
+                              nbytes)
             return
 
         cblk, uniq, cnts = payload

@@ -132,6 +132,50 @@ def fm_predict_panel(params: FMParams, pb) -> jnp.ndarray:
     return fm_predict_panel_xv(params, pb)[0]
 
 
+def _fm_grad_panel_sorted(params: FMParams, pb, p: jnp.ndarray,
+                          XV: Optional[jnp.ndarray]
+                          ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Sorted-token backward (pb.sorted_* present, ops/batch.py
+    panel_sort_tokens): contributions are computed directly IN
+    lane-sorted order — a gather from the small [B, k+1] row-quantity
+    array — and merged with a sorted segment reduction. Measured 1.43x
+    over the unsorted scatter at bench shapes (B=65536, F=39, k=64): the
+    scatter's random read-modify-write of [U, k+2] rows becomes one
+    ascending pass. f32 contributions measured FASTER than bf16 here (the
+    cast inside the sorted scatter costs more than the bandwidth saves).
+
+    For binary panels gw == xxp (x == x^2), so the reduction carries k+1
+    columns; with values the k+2nd column weights by v^2."""
+    U = params.w.shape[0]
+    if params.V is None or params.V.shape[1] == 0:
+        contrib = p[pb.sorted_rows]
+        if pb.sorted_vals is not None:
+            contrib = contrib * pb.sorted_vals
+        gw = jnp.zeros((U,), jnp.float32).at[pb.sorted_lane].add(
+            contrib, indices_are_sorted=True)
+        return gw, None
+    k = params.V.shape[1]
+    vm = _vmask(params)
+    Vm = (params.V * vm.astype(params.V.dtype)[:, None]).astype(jnp.float32)
+    pXV = p[:, None] * XV                            # [B, k]
+    if pb.sorted_vals is None:
+        row_q = jnp.concatenate([pXV, p[:, None]], axis=1)   # [B, k+1]
+        red = jnp.zeros((U, k + 1), jnp.float32).at[pb.sorted_lane].add(
+            row_q[pb.sorted_rows], indices_are_sorted=True)
+        t1, gw = red[:, :k], red[:, k]
+        xxp = gw
+    else:
+        row_q = jnp.concatenate([pXV, p[:, None], p[:, None]], axis=1)
+        v = pb.sorted_vals[:, None]
+        scale = jnp.concatenate(
+            [jnp.broadcast_to(v, (v.shape[0], k + 1)), v * v], axis=1)
+        red = jnp.zeros((U, k + 2), jnp.float32).at[pb.sorted_lane].add(
+            row_q[pb.sorted_rows] * scale, indices_are_sorted=True)
+        t1, gw, xxp = red[:, :k], red[:, k], red[:, k + 1]
+    gV = (t1 - xxp[:, None] * Vm) * vm[:, None]
+    return gw, gV
+
+
 def fm_grad_panel(params: FMParams, pb, pred: jnp.ndarray,
                   xv: Optional[jnp.ndarray] = None
                   ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
@@ -139,10 +183,16 @@ def fm_grad_panel(params: FMParams, pb, pred: jnp.ndarray,
     of row quantities (p, p*XV), merged by ONE combined segment reduction
     [B*F, k+2] -> [U, k+2] for (t1 | gw | xxp). Same math as fm_grad
     (fm_loss.h:124-126,148-203). ``xv`` is the forward's X·V
-    (fm_predict_panel_xv); None re-gathers the tokens to rebuild it."""
+    (fm_predict_panel_xv); None re-gathers the tokens to rebuild it.
+    Batches carrying a presorted token order (panel_sort_tokens) take the
+    sorted fast path instead."""
     U = params.w.shape[0]
     B, F = pb.idx.shape
     p = _p_vector(pred, pb)                          # [B]
+    if pb.sorted_lane is not None:
+        if params.V is not None and params.V.shape[1] > 0 and xv is None:
+            _, xv = fm_predict_panel_xv(params, pb)
+        return _fm_grad_panel_sorted(params, pb, p, xv)
     flat_idx = pb.idx.reshape(B * F)
     if params.V is None or params.V.shape[1] == 0:
         cell = jnp.broadcast_to(p[:, None], (B, F))

@@ -21,7 +21,8 @@ log = logging.getLogger("difacto_tpu")
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "_difacto_native.so")
 _SRC = [os.path.join(_DIR, "libsvm_parser.cc"),
-        os.path.join(_DIR, "criteo_parser.cc")]
+        os.path.join(_DIR, "criteo_parser.cc"),
+        os.path.join(_DIR, "adfea_parser.cc")]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -47,7 +48,13 @@ def _build() -> bool:
 
 
 def _newest_src_mtime() -> float:
-    return max(os.path.getmtime(s) for s in _SRC)
+    # a missing source (partial checkout) must not break get_lib's
+    # fallback contract — treat it as infinitely new so the build is
+    # attempted, fails, and callers fall back to Python
+    try:
+        return max(os.path.getmtime(s) for s in _SRC)
+    except OSError:
+        return float("inf")
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -83,6 +90,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.difacto_parse_adfea.restype = ctypes.c_int
+        lib.difacto_parse_adfea.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
         ]
         lib.difacto_murmur64a.restype = ctypes.c_uint64

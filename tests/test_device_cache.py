@@ -122,3 +122,41 @@ def test_cache_iter_parts_order_and_permutation():
     assert [p for p, _ in shuf] == [p for p, _ in plain]
     assert sorted(shuf) == sorted(plain)
     assert list(c.iter_parts(True, seed=3)) == shuf  # deterministic
+
+
+def test_panel_replay_sorted_backward(tmp_path):
+    """Criteo-format (uniform-width panel) cached replay: epochs 1+ take
+    the sorted-token backward (panel_sort_tokens staged at cache time) and
+    reproduce the streamed trajectory; only summation order differs."""
+    rng = np.random.RandomState(5)
+    path = tmp_path / "criteo.txt"
+    with open(path, "w") as f:
+        for _ in range(200):
+            ints = [str(rng.randint(0, 50)) for _ in range(13)]
+            cats = [f"c{rng.randint(0, 400)}" for _ in range(26)]
+            f.write("\t".join([str(rng.randint(0, 2))] + ints + cats) + "\n")
+
+    def run(cache_mb):
+        args = [("data_in", str(path)), ("data_format", "criteo"),
+                ("loss", "fm"), ("V_dim", "4"), ("V_threshold", "0"),
+                ("lr", "0.1"), ("l1", "0.01"), ("l2", "0"),
+                ("batch_size", "50"), ("shuffle", "0"),
+                ("max_num_epochs", "5"), ("num_jobs_per_epoch", "1"),
+                ("report_interval", "0"), ("stop_rel_objv", "0"),
+                ("hash_capacity", str(1 << 14)),
+                ("device_cache_mb", str(cache_mb))]
+        learner = Learner.create("sgd")
+        learner.init(args)
+        seen = []
+        learner.add_epoch_end_callback(lambda e, t, v: seen.append(t.loss))
+        learner.run()
+        return np.array(seen), learner
+
+    ref, _ = run(0)
+    got, learner = run(256)
+    cache = learner._dev_caches[K_TRAINING]
+    assert cache.ready
+    # the cached payloads really carry the sorted order (panel path)
+    payloads = [pl for items in cache.entries.values() for pl in items]
+    assert payloads and all(pl[0] == "panel_sorted" for pl in payloads)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

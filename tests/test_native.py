@@ -158,3 +158,33 @@ def test_criteo_native_test_mode_and_crlf():
     assert a.size == b.size == 2  # the blank CRLF line is not a row
     np.testing.assert_array_equal(a.label, b.label)
     np.testing.assert_array_equal(a.index, b.index)
+
+
+@needs_native
+def test_adfea_native_matches_python():
+    from difacto_tpu.data.native_parsers import parse_adfea_native
+    from difacto_tpu.data.parsers import parse_adfea
+    rng = np.random.RandomState(3)
+    lines = []
+    for i in range(200):
+        feats = " ".join(f"{rng.randint(0, 1 << 40)}:{rng.randint(0, 9000)}"
+                         for _ in range(rng.randint(1, 12)))
+        lines.append(f"{i} {rng.randint(1, 5)} {rng.randint(0, 2)} {feats}")
+    chunk = ("\n".join(lines) + "\n").encode()
+    a = parse_adfea(chunk)
+    b = parse_adfea_native(chunk)
+    assert a.size == b.size == 200
+    np.testing.assert_array_equal(a.offset, b.offset)
+    np.testing.assert_array_equal(a.label, b.label)
+    np.testing.assert_array_equal(a.index, b.index)
+    assert a.value is None and b.value is None
+
+    # space-only separators (single line, the max_rows sizing edge) and
+    # tab separators
+    flat = (" ".join(lines[:50])).encode()
+    a, b = parse_adfea(flat), parse_adfea_native(flat)
+    assert a.size == b.size == 50
+    np.testing.assert_array_equal(a.index, b.index)
+    tabbed = chunk.replace(b" ", b"\t")
+    a, b = parse_adfea(tabbed), parse_adfea_native(tabbed)
+    np.testing.assert_array_equal(a.offset, b.offset)
