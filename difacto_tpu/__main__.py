@@ -95,7 +95,7 @@ def main(argv: list[str] | None = None) -> int:
             remain.append(("task", "2"))
         remain = learner.init(remain)
         warn_unknown(remain)
-        from .parallel.fault import EXIT_PEER_DEAD, HostFailure
+        from .parallel.fault import HostFailure, exit_code_for
         try:
             learner.run()
         except HostFailure as e:
@@ -103,7 +103,7 @@ def main(argv: list[str] | None = None) -> int:
             # launcher (launch.py --max-restarts) evicts it and resumes
             # from the last checkpoint (parallel/fault.py)
             log.error("aborting for restart: %s", e)
-            return EXIT_PEER_DEAD
+            return exit_code_for(e.dead)
     elif param.task == "dump":
         warn_unknown(run_dump(remain))
     elif param.task == "convert":

@@ -46,6 +46,15 @@ log = logging.getLogger("difacto_tpu")
 EXIT_PEER_DEAD = 42  # process exit code for "aborted because a peer died"
 
 
+def exit_code_for(dead: List[int]) -> int:
+    """Exit code that also TELLS the launcher which peer died, so it can
+    evict the right host: 100 + min(dead_rank) for ranks < 28 (codes
+    101..127 — still below the shell's 128+signo band), else the generic
+    EXIT_PEER_DEAD."""
+    r = min(dead) if dead else -1
+    return 100 + r if 0 <= r < 28 else EXIT_PEER_DEAD
+
+
 class HostFailure(RuntimeError):
     """A peer host is dead; the synchronized schedule cannot continue."""
 
@@ -154,11 +163,11 @@ class HeartbeatMonitor:
             return
         dead = self.dead_peers()
         if dead:
+            code = exit_code_for(dead)
             log.error(
                 "host %d: peer(s) %s dead while blocked in a collective "
-                "— aborting for restart (exit %d)", self.rank, dead,
-                EXIT_PEER_DEAD)
-            os._exit(EXIT_PEER_DEAD)
+                "— aborting for restart (exit %d)", self.rank, dead, code)
+            os._exit(code)
 
     # ------------------------------------------------------------ queries
     def dead_peers(self) -> List[int]:

@@ -5,69 +5,153 @@ The reference submits scheduler/server/worker processes via dmlc-tracker
 (launch.py:32-78, run_local/ssh/yarn.sh) and its DistTracker reassigns a
 dead node's work (src/tracker/dist_tracker.h:164-186). The TPU framework is
 multi-controller SPMD: every process runs the SAME program; this launcher
-starts ``-n`` local processes with the rendezvous env
+starts ``-n`` processes with the rendezvous env
 (DIFACTO_COORDINATOR/NPROCS/RANK -> jax.distributed.initialize, see
-difacto_tpu/parallel/multihost.py). On a real TPU pod each host's runtime
-(GKE/xpk/ray) sets the equivalent variables instead.
+difacto_tpu/parallel/multihost.py).
+
+Launch modes (--launcher, the dmlc-tracker cluster types):
+  local  processes on this machine (default);
+  ssh    one process per line of ``-H hostfile`` (the run_ssh.sh path,
+         /root/reference/run_ssh.sh:1, example/ip_list.txt): the
+         rendezvous coordinator is the first host, env rides the remote
+         command line, and ``--sync-dst-dir`` rsyncs the working dir to
+         every host first (dmlc-tracker's sync behavior). On managed
+         clusters (k8s/xpk/slurm, the yarn equivalents) the scheduler
+         sets the DIFACTO_* variables itself — no launcher needed.
 
 ``--max-restarts k`` adds the recovery loop of the dead-host protocol
 (difacto_tpu/parallel/fault.py): heartbeat env is exported so workers
-detect peer death and abort instead of hanging; when any process fails,
-the launcher kills the stragglers, EVICTS one host (local stand-in for
-"the dead machine is gone"), and relaunches the survivors — byte-range
-input sharding re-partitions the data over them and training resumes from
-the last epoch checkpoint (SGDLearner ckpt_interval/auto_resume).
+detect peer death and abort instead of hanging; when a process dies by
+signal or aborts with EXIT_PEER_DEAD, the launcher kills the stragglers,
+EVICTS one host, and relaunches the survivors — byte-range input sharding
+re-partitions the data over them and training resumes from the last epoch
+checkpoint (SGDLearner ckpt_interval/auto_resume).
 
 Usage:
     python launch.py -n 2 -- python -m difacto_tpu train.conf k=v ...
     python launch.py -n 2 --max-restarts 1 -- python -m difacto_tpu ...
+    python launch.py -H hosts.txt --launcher ssh --sync-dst-dir /tmp/job \\
+        -- python -m difacto_tpu train.conf
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import subprocess
 import sys
 import time
 
 
-def _spawn(cmd, n, port, attempt, args):
+def _read_hostfile(path: str) -> list:
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                hosts.append(line.split()[0])
+    if not hosts:
+        raise SystemExit(f"hostfile {path} lists no hosts")
+    return hosts
+
+
+def _sync(hosts, dst, args) -> None:
+    """rsync the working directory to each ACTIVE host, concurrently
+    (dmlc-tracker ssh launcher behavior, reference launch.py:41-44
+    sync_dst_dir)."""
+    src = os.getcwd() + "/"
+    procs = [subprocess.Popen(
+        args.rsync_cmd.split() + ["-az", "--delete", src, f"{h}:{dst}/"])
+        for h in hosts]
+    for h, p in zip(hosts, procs):
+        if p.wait() != 0:
+            raise SystemExit(f"rsync to {h} failed")
+
+
+def _rank_env(rank, n, hosts, port, attempt, args) -> dict:
+    coord = (hosts[0] if hosts else "127.0.0.1")
+    env = {
+        "DIFACTO_COORDINATOR": f"{coord}:{port}",
+        "DIFACTO_NPROCS": str(n),
+        "DIFACTO_RANK": str(rank),
+        "DIFACTO_RESTART": str(attempt),
+    }
+    if args.max_restarts > 0:
+        env.update(
+            DIFACTO_HB_PORT=str(args.hb_port + 64 * attempt),
+            DIFACTO_HB_TIMEOUT=str(args.hb_timeout),
+        )
+        if hosts:
+            env["DIFACTO_HB_PEERS"] = ",".join(hosts)
+    return env
+
+
+def _spawn(cmd, n, hosts, port, attempt, args):
     procs = []
     for rank in range(n):
-        env = dict(os.environ)
-        env.update(
-            DIFACTO_COORDINATOR=f"127.0.0.1:{port}",
-            DIFACTO_NPROCS=str(n),
-            DIFACTO_RANK=str(rank),
-            DIFACTO_RESTART=str(attempt),
-        )
-        if args.max_restarts > 0:
-            env.update(
-                DIFACTO_HB_PORT=str(args.hb_port + 64 * attempt),
-                DIFACTO_HB_TIMEOUT=str(args.hb_timeout),
-            )
-        procs.append(subprocess.Popen(cmd, env=env))
+        extra = _rank_env(rank, n, hosts, port, attempt, args)
+        if hosts:
+            # env must ride the remote command line: ssh does not forward
+            # the local environment
+            envs = " ".join(f"{k}={shlex.quote(v)}"
+                            for k, v in extra.items())
+            wd = args.sync_dst_dir or "."
+            remote = (f"cd {shlex.quote(wd)} && env {envs} "
+                      + " ".join(shlex.quote(c) for c in cmd))
+            full = args.ssh_cmd.split() + [hosts[rank], remote]
+            procs.append(subprocess.Popen(full))
+        else:
+            env = dict(os.environ)
+            env.update(extra)
+            procs.append(subprocess.Popen(cmd, env=env))
     return procs
 
 
-def _run_once(cmd, n, port, attempt, args) -> int:
-    """0 = all exited cleanly; else the first nonzero rc (stragglers are
-    killed: a failed peer leaves them blocked or doomed to abort)."""
-    procs = _spawn(cmd, n, port, attempt, args)
+def _is_signal_death(rc: int, ssh: bool) -> bool:
+    """Negative rc = local signal death. The >128 band (shell convention
+    128+signo; 255 = ssh could not reach the host) only means signal
+    death when the status was relayed through ssh — a LOCAL worker
+    exiting 255 is a deterministic error, not a dead host."""
+    return rc < 0 or (ssh and rc > 128)
+
+
+def _peer_dead_rank(rc: int) -> int:
+    """Dead rank encoded by fault.exit_code_for (101..127), else -1."""
+    return rc - 100 if 100 < rc < 128 else -1
+
+
+def _run_once(cmd, n, hosts, port, attempt, args):
+    """(rc, failed_rank): rc 0 = all exited cleanly; else the first
+    nonzero rc and its rank (stragglers are killed: a failed peer leaves
+    them blocked or doomed to abort)."""
+    procs = _spawn(cmd, n, hosts, port, attempt, args)
     try:
         while True:
             rcs = [p.poll() for p in procs]
-            bad = [rc for rc in rcs if rc not in (None, 0)]
+            bad = [(rank, rc) for rank, rc in enumerate(rcs)
+                   if rc not in (None, 0)]
             if bad:
                 for p in procs:
                     if p.poll() is None:
                         p.kill()
                 for p in procs:
                     p.wait()
-                return bad[0]
+                # eviction preference: a directly-observed signal death
+                # (the dead host itself), else a survivor's encoded
+                # dead-rank report, else whatever failed first
+                ssh = bool(hosts)
+
+                def prio(t):
+                    if _is_signal_death(t[1], ssh):
+                        return 0
+                    if _peer_dead_rank(t[1]) >= 0:
+                        return 1
+                    return 2
+                bad.sort(key=prio)
+                return bad[0][1], bad[0][0]
             if all(rc == 0 for rc in rcs):
-                return 0
+                return 0, -1
             time.sleep(0.2)
     finally:
         for p in procs:
@@ -77,7 +161,21 @@ def _run_once(cmd, n, port, attempt, args) -> int:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("-n", "--num-processes", type=int, default=1)
+    ap.add_argument("-n", "--num-processes", type=int, default=0,
+                    help="process count (default: 1, or the hostfile "
+                         "length with -H)")
+    ap.add_argument("-H", "--hostfile", default="",
+                    help="one host per line (# comments ok); used by the "
+                         "ssh launcher, reference example/ip_list.txt")
+    ap.add_argument("--launcher", choices=("local", "ssh"),
+                    default="local")
+    ap.add_argument("--sync-dst-dir", default="",
+                    help="rsync the current directory to this path on "
+                         "every host before launching (ssh mode)")
+    ap.add_argument("--ssh-cmd", default="ssh",
+                    help="ssh executable + base flags (override for "
+                         "tests or for gcloud compute ssh wrappers)")
+    ap.add_argument("--rsync-cmd", default="rsync")
     ap.add_argument("--port", type=int, default=7799)
     ap.add_argument("--max-restarts", type=int, default=0,
                     help="recovery attempts after a host failure: evict "
@@ -96,12 +194,24 @@ def main() -> int:
     if not cmd:
         ap.error("no command given")
 
-    n = args.num_processes
+    hosts = []
+    if args.launcher == "ssh":
+        if not args.hostfile:
+            ap.error("--launcher ssh requires -H/--hostfile")
+        hosts = _read_hostfile(args.hostfile)
+        if args.sync_dst_dir:
+            _sync(hosts, args.sync_dst_dir, args)
+    n = args.num_processes or (len(hosts) if hosts else 1)
+    if hosts and n > len(hosts):
+        ap.error(f"-n {n} exceeds the {len(hosts)} hostfile entries")
+
     rc = 0
+    cur_hosts = hosts[:n]
     for attempt in range(args.max_restarts + 1):
         # fresh rendezvous + heartbeat ports per attempt: the previous
         # coordinator socket may linger in TIME_WAIT
-        rc = _run_once(cmd, n, args.port + 7 * attempt, attempt, args)
+        rc, bad_rank = _run_once(cmd, n, cur_hosts, args.port + 7 * attempt,
+                                 attempt, args)
         if rc == 0:
             return 0
         if attempt == args.max_restarts:
@@ -114,13 +224,38 @@ def main() -> int:
             from difacto_tpu.parallel.fault import EXIT_PEER_DEAD
         except ImportError:  # launched from outside the repo
             EXIT_PEER_DEAD = 42
-        if rc != EXIT_PEER_DEAD and rc >= 0:
+        ssh = bool(cur_hosts)
+        recoverable = (rc == EXIT_PEER_DEAD or _peer_dead_rank(rc) >= 0
+                       or _is_signal_death(rc, ssh))
+        if not recoverable:
             print(f"[launch] attempt {attempt} failed with non-recovery "
                   f"rc={rc}; not restarting", file=sys.stderr)
             break
+        if cur_hosts and len(cur_hosts) == 1:
+            print("[launch] no hosts left to evict; giving up",
+                  file=sys.stderr)
+            break
         n = max(1, n - 1)
-        print(f"[launch] attempt {attempt} failed (rc={rc}); evicting one "
-              f"host, relaunching {n} process(es)", file=sys.stderr)
+        if cur_hosts:
+            # whom to evict: the signal-dead rank if the launcher saw it
+            # die, else the dead rank a survivor reported via its encoded
+            # exit code, else fall back to the last host
+            if _is_signal_death(rc, ssh) and bad_rank >= 0:
+                victim = bad_rank
+            elif 0 <= _peer_dead_rank(rc) < len(cur_hosts):
+                victim = _peer_dead_rank(rc)
+            else:
+                victim = len(cur_hosts) - 1
+            evicted = cur_hosts.pop(victim)
+            # ssh cannot kill remote stragglers; give orphans of the
+            # failed attempt one heartbeat timeout to notice their dead
+            # peers and self-abort before the relaunch races them
+            time.sleep(args.hb_timeout)
+            print(f"[launch] attempt {attempt} failed (rc={rc}); evicting "
+                  f"{evicted}, relaunching on {cur_hosts}", file=sys.stderr)
+        else:
+            print(f"[launch] attempt {attempt} failed (rc={rc}); evicting "
+                  f"one host, relaunching {n} process(es)", file=sys.stderr)
     return rc
 
 

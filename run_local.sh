@@ -1,0 +1,3 @@
+#!/bin/bash
+# single-process local run (reference run_local.sh equivalent)
+python -m difacto_tpu examples/local.conf "$@"

@@ -1,0 +1,6 @@
+#!/bin/bash
+# 2-host ssh cluster run (reference run_ssh.sh equivalent):
+# one SPMD process per line of examples/ip_list.txt, working dir rsynced
+python launch.py --launcher ssh -H examples/ip_list.txt \
+    --sync-dst-dir /tmp/difacto_tpu --max-restarts 1 \
+    -- python -m difacto_tpu examples/local.conf "$@"

@@ -65,13 +65,13 @@ ln.init([("data_in", data), ("V_dim", "2"), ("V_threshold", "2"),
 seen = []
 ln.add_epoch_end_callback(lambda e, t, v: seen.append((e, t.loss)))
 
-from difacto_tpu.parallel.fault import EXIT_PEER_DEAD, HostFailure  # noqa
+from difacto_tpu.parallel.fault import HostFailure, exit_code_for  # noqa
 
 try:
     ln.run()
 except HostFailure as e:
     print(f"rank {rank}: {e}", flush=True)
-    sys.exit(EXIT_PEER_DEAD)
+    sys.exit(exit_code_for(e.dead))
 
 with open(os.path.join(out_dir, f"traj-{rank}.json"), "w") as f:
     json.dump({"epochs": seen, "attempt": int(attempt),

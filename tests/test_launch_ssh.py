@@ -1,0 +1,92 @@
+"""launch.py --launcher ssh (the run_ssh.sh / dmlc-tracker ssh path,
+/root/reference/run_ssh.sh:1, reference launch.py:32-78) — exercised with
+a fake ssh shim that runs the remote command locally, so the test needs no
+real cluster: hostfile parsing, per-rank env on the remote command line,
+coordinator = first host, and eviction of the failed host on restart."""
+
+import json
+import os
+import pathlib
+import stat
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+SHIM = """#!/bin/sh
+# fake ssh: $1 = host, $2 = remote command; run it locally, recording the
+# target host for the test
+echo "$1" >> "$SHIM_LOG"
+exec sh -c "$2"
+"""
+
+
+def _write_shim(tmp_path):
+    shim = tmp_path / "fake_ssh"
+    shim.write_text(SHIM)
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    return shim
+
+
+def test_ssh_launcher_env_and_hosts(tmp_path):
+    shim = _write_shim(tmp_path)
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("# comment\nhostA extra tokens\nhostB\n")
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import json, os, sys\n"
+        "out = sys.argv[1]\n"
+        "rank = os.environ['DIFACTO_RANK']\n"
+        "with open(f'{out}/r{rank}.json', 'w') as f:\n"
+        "    json.dump({k: v for k, v in os.environ.items()\n"
+        "               if k.startswith('DIFACTO')}, f)\n")
+    env = dict(os.environ, SHIM_LOG=str(tmp_path / "shim.log"))
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "launch.py"), "--launcher", "ssh",
+         "-H", str(hostfile), "--ssh-cmd", str(shim), "--port", "7961",
+         "--", sys.executable, str(worker), str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    # one process per hostfile entry, ssh'd to the right hosts
+    assert sorted((tmp_path / "shim.log").read_text().split()) == \
+        ["hostA", "hostB"]
+    envs = {}
+    for r in (0, 1):
+        with open(tmp_path / f"r{r}.json") as f:
+            envs[r] = json.load(f)
+    assert envs[0]["DIFACTO_NPROCS"] == "2"
+    assert envs[1]["DIFACTO_RANK"] == "1"
+    # rendezvous coordinator is the FIRST host for every rank
+    assert envs[0]["DIFACTO_COORDINATOR"].startswith("hostA:")
+    assert envs[1]["DIFACTO_COORDINATOR"] == envs[0]["DIFACTO_COORDINATOR"]
+
+
+def test_ssh_launcher_evicts_failed_host(tmp_path):
+    shim = _write_shim(tmp_path)
+    hostfile = tmp_path / "hosts.txt"
+    hostfile.write_text("hostA\nhostB\n")
+    worker = tmp_path / "worker.py"
+    # attempt 0: rank 0 (hostA) dies by signal; attempt 1 must run on
+    # hostB alone and succeed
+    worker.write_text(
+        "import os, signal, sys\n"
+        "out = sys.argv[1]\n"
+        "rank = os.environ['DIFACTO_RANK']\n"
+        "attempt = os.environ['DIFACTO_RESTART']\n"
+        "open(f'{out}/a{attempt}-r{rank}-'\n"
+        "     f'{os.environ[\"DIFACTO_COORDINATOR\"].split(\":\")[0]}',\n"
+        "     'w').close()\n"
+        "if attempt == '0' and rank == '0':\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n")
+    env = dict(os.environ, SHIM_LOG=str(tmp_path / "shim.log"))
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "launch.py"), "--launcher", "ssh",
+         "-H", str(hostfile), "--ssh-cmd", str(shim), "--port", "7971",
+         "--max-restarts", "1",
+         "--", sys.executable, str(worker), str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "evicting hostA" in proc.stderr
+    # attempt 1 ran a single process on hostB, with hostB the coordinator
+    marks = sorted(p.name for p in tmp_path.glob("a1-*"))
+    assert marks == ["a1-r0-hostB"]
