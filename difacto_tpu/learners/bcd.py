@@ -72,6 +72,14 @@ class BCDLearnerParam(Param):
     # analog is TileStore's cache over DataStore.
     tile_cache_items: int = 0
     tile_cache_mb: int = 1024
+    # shard the ROW axis over a dp device mesh: each device holds its row
+    # slice of every tile (pred/labels/mask + the per-block COO entries
+    # whose rows land in it) and the per-block (g, h) contraction becomes
+    # per-device segment-sums + a psum — the TPU analog of the reference's
+    # workers computing partial block gradients that the servers sum
+    # (bcd_learner.cc:236-263, bcd_updater.h:139-159). The diag-Newton
+    # update stays replicated (O(block) elementwise). 1 = single device.
+    mesh_dp: int = 1
 
 
 @dataclass
@@ -164,8 +172,56 @@ class BCDLearner(Learner):
 
     def _build_steps(self) -> None:
         from ..losses.logit_delta import delta_grad, delta_pred_update
+        self.mesh = None
+        if self.param.mesh_dp > 1:
+            from functools import partial
+
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            shard_map = jax.shard_map
+
+            from ..parallel import DP_AXIS, make_mesh
+            self.mesh = make_mesh(dp=self.param.mesh_dp, fs=1)
+            self._row_shard = NamedSharding(self.mesh, P(DP_AXIS))
+            self._coo_shard = NamedSharding(self.mesh, P(DP_AXIS, None))
+            mesh, dp_axis = self.mesh, DP_AXIS
+
+            @partial(jax.jit, static_argnums=6)
+            def grad_gh(pred, labels, mask, rows, cols, vals, nf_cap):
+                def body(pred, labels, mask, rows, cols, vals):
+                    blk = _BlockSlice(rows=rows[0], cols=cols[0],
+                                      vals=vals[0])
+                    g, h = delta_grad(pred, labels, mask, blk, nf_cap)
+                    return (jax.lax.psum(g, dp_axis),
+                            jax.lax.psum(h, dp_axis))
+                return shard_map(
+                    body, mesh=mesh,
+                    in_specs=(P(dp_axis), P(dp_axis), P(dp_axis),
+                              P(dp_axis, None), P(dp_axis, None),
+                              P(dp_axis, None)),
+                    out_specs=(P(), P()))(pred, labels, mask, rows, cols,
+                                          vals)
+
+            @partial(jax.jit, donate_argnums=0)
+            def pred_add(pred, rows, cols, vals, d):
+                def body(pred, rows, cols, vals, d):
+                    blk = _BlockSlice(rows=rows[0], cols=cols[0],
+                                      vals=vals[0])
+                    return delta_pred_update(pred, blk, d)
+                return shard_map(
+                    body, mesh=mesh,
+                    in_specs=(P(dp_axis), P(dp_axis, None),
+                              P(dp_axis, None), P(dp_axis, None), P()),
+                    out_specs=P(dp_axis))(pred, rows, cols, vals, d)
+
+            self._grad_gh_sharded = grad_gh
+            self._pred_add_sharded = pred_add
         self._grad_gh = jax.jit(delta_grad, static_argnums=4)
         self._pred_add = jax.jit(delta_pred_update, donate_argnums=0)
+
+    def _place_rows(self, arr: np.ndarray) -> jnp.ndarray:
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        return jax.device_put(arr, self._row_shard)
 
     # ----------------------------------------------------------- data prep
     def _prepare(self) -> None:
@@ -225,25 +281,28 @@ class BCDLearner(Learner):
 
         # device tiles: labels/mask/pred per row tile; per (tile, block)
         # COO slices built lazily and cached
+        from ..ops.batch import mesh_dim_min
+        dim_min = 8 if self.mesh is None else mesh_dim_min(p.mesh_dp)
         self.tiles = []
         for cblk, uniq, is_train in raw:
             colmap = find_position(self.feaids, uniq)
             col_global = colmap[cblk.index]  # -1 where filtered
-            b_cap = bucket(cblk.size)
+            b_cap = bucket(cblk.size, dim_min)
             labels = np.zeros(b_cap, dtype=np.float32)
             labels[:cblk.size] = cblk.label
             mask = np.zeros(b_cap, dtype=np.float32)
             mask[:cblk.size] = 1.0
             self.tiles.append(dict(
                 size=cblk.size,
+                b_cap=b_cap,
                 is_train=is_train,
                 rows=cblk.row_ids(),
                 col_global=col_global,
                 vals=cblk.values_or_ones(),
                 label_np=cblk.label,
-                labels=jnp.asarray(labels),
-                mask=jnp.asarray(mask),
-                pred=jnp.zeros(b_cap, dtype=jnp.float32),
+                labels=self._place_rows(labels),
+                mask=self._place_rows(mask),
+                pred=self._place_rows(np.zeros(b_cap, dtype=np.float32)),
             ))
         from ..data.tile_store import TileCache
         self._tile_cache = TileCache(self._build_slice,
@@ -251,22 +310,46 @@ class BCDLearner(Learner):
                                      max_bytes=p.tile_cache_mb << 20)
 
     def _build_slice(self, t: int, f: int) -> Optional[_BlockSlice]:
-        """Device COO of tile t's columns in block f (block-local ids)."""
+        """Device COO of tile t's columns in block f (block-local ids).
+        Under a mesh the arrays are [dp, cap] with device-LOCAL row ids:
+        entry (r, c, v) lands on the device whose row shard holds r."""
         tile = self.tiles[t]
         b_lo, b_hi = self.blocks[f]
         m = (tile["col_global"] >= b_lo) & (tile["col_global"] < b_hi)
         nnz = int(m.sum())
         if nnz == 0:
             return None
-        cap = bucket(nnz)
-        rows = np.zeros(cap, dtype=np.int32)
-        rows[:nnz] = tile["rows"][m]
-        cols = np.zeros(cap, dtype=np.int32)
-        cols[:nnz] = tile["col_global"][m] - b_lo
-        vals = np.zeros(cap, dtype=np.float32)
-        vals[:nnz] = tile["vals"][m]
-        return _BlockSlice(rows=jnp.asarray(rows), cols=jnp.asarray(cols),
-                           vals=jnp.asarray(vals))
+        rows_g = tile["rows"][m].astype(np.int64)
+        cols_g = (tile["col_global"][m] - b_lo).astype(np.int32)
+        vals_g = tile["vals"][m].astype(np.float32)
+        if self.mesh is None:
+            cap = bucket(nnz)
+            rows = np.zeros(cap, dtype=np.int32)
+            rows[:nnz] = rows_g
+            cols = np.zeros(cap, dtype=np.int32)
+            cols[:nnz] = cols_g
+            vals = np.zeros(cap, dtype=np.float32)
+            vals[:nnz] = vals_g
+            return _BlockSlice(rows=jnp.asarray(rows),
+                               cols=jnp.asarray(cols),
+                               vals=jnp.asarray(vals))
+        dp = self.param.mesh_dp
+        shard = tile["b_cap"] // dp
+        dev = rows_g // shard
+        cap = bucket(max(int(np.bincount(dev, minlength=dp).max()), 1))
+        rows = np.zeros((dp, cap), dtype=np.int32)
+        cols = np.zeros((dp, cap), dtype=np.int32)
+        vals = np.zeros((dp, cap), dtype=np.float32)
+        for d in range(dp):
+            sel = dev == d
+            k = int(sel.sum())
+            rows[d, :k] = rows_g[sel] - d * shard
+            cols[d, :k] = cols_g[sel]
+            vals[d, :k] = vals_g[sel]
+        return _BlockSlice(
+            rows=jax.device_put(rows, self._coo_shard),
+            cols=jax.device_put(cols, self._coo_shard),
+            vals=jax.device_put(vals, self._coo_shard))
 
     def _block_slice(self, t: int, f: int) -> Optional[_BlockSlice]:
         return self._tile_cache.fetch(t, f)
@@ -287,8 +370,13 @@ class BCDLearner(Learner):
             s = self._block_slice(t, f)
             if s is None:
                 continue
-            dg, dh = self._grad_gh(tile["pred"], tile["labels"],
-                                   tile["mask"], s, nf_cap)
+            if self.mesh is not None:
+                dg, dh = self._grad_gh_sharded(
+                    tile["pred"], tile["labels"], tile["mask"],
+                    s.rows, s.cols, s.vals, nf_cap)
+            else:
+                dg, dh = self._grad_gh(tile["pred"], tile["labels"],
+                                       tile["mask"], s, nf_cap)
             g = g + dg
             h = h + dh
 
@@ -312,7 +400,11 @@ class BCDLearner(Learner):
             s = self._block_slice(t, f)
             if s is None:
                 continue
-            tile["pred"] = self._pred_add(tile["pred"], s, d_dev)
+            if self.mesh is not None:
+                tile["pred"] = self._pred_add_sharded(
+                    tile["pred"], s.rows, s.cols, s.vals, d_dev)
+            else:
+                tile["pred"] = self._pred_add(tile["pred"], s, d_dev)
 
     def _progress(self) -> BCDProgress:
         count = objv = auc = acc = 0.0
@@ -372,4 +464,4 @@ class BCDLearner(Learner):
             valid = tile["col_global"] >= 0
             np.add.at(pred, tile["rows"][valid],
                       tile["vals"][valid] * self.w[tile["col_global"][valid]])
-            tile["pred"] = jnp.asarray(pred)
+            tile["pred"] = self._place_rows(pred)

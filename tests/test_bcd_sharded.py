@@ -1,0 +1,55 @@
+"""Mesh-sharded BCD (round-3 verdict: distributed BCD): tile rows shard
+over an 8-device dp mesh — each device holds its row slice of every
+tile's pred/labels/mask and the per-block COO entries landing in it; the
+per-block (g, h) contraction is per-device segment-sums + a psum, the
+TPU analog of the reference's workers computing partial block gradients
+that the servers sum (src/bcd/bcd_learner.cc:236-263,
+src/bcd/bcd_updater.h:139-159).
+
+The golden trajectory must be REPRODUCED, not approximated: sharding
+a reduction changes the machine, not the math (fp order at ~1e-7; the
+goldens tolerate 1e-5)."""
+
+import numpy as np
+
+from difacto_tpu.learners import Learner
+from tests.test_bcd import OBJV_DIAG_NEWTON
+
+
+def run_sharded(rcv1_path, **over):
+    args = {"data_in": rcv1_path, "l1": ".1", "lr": ".05",
+            "block_ratio": "0.001", "tail_feature_filter": "0",
+            "max_num_epochs": "10", "mesh_dp": "8"}
+    args.update({k: str(v) for k, v in over.items()})
+    learner = Learner.create("bcd")
+    remain = learner.init(list(args.items()))
+    assert remain == []
+    seen = []
+    learner.add_epoch_end_callback(lambda e, p: seen.append(p.objv))
+    learner.run()
+    return learner, np.array(seen)
+
+
+def test_bcd_sharded_golden(rcv1_path):
+    learner, seen = run_sharded(rcv1_path)
+    np.testing.assert_allclose(seen, OBJV_DIAG_NEWTON, rtol=1e-4)
+    # the row arrays are ACTUALLY sharded over all 8 devices
+    pred = learner.tiles[0]["pred"]
+    devs = {s.device for s in pred.addressable_shards}
+    assert len(devs) == 8
+    for s in pred.addressable_shards:
+        assert s.data.shape[0] == pred.shape[0] // 8
+
+
+def test_bcd_sharded_multi_block_optimum(rcv1_path):
+    """block_ratio=1 (multiple blocks) converges to the same optimum on
+    the mesh (bcd_learner_test.cc:40-65 family)."""
+    learner, seen = run_sharded(rcv1_path, block_ratio="1",
+                                max_num_epochs="60", random_block="0")
+    # single-device reference with identical config
+    ref_learner, ref_seen = run_sharded(
+        rcv1_path, block_ratio="1", max_num_epochs="60", random_block="0",
+        mesh_dp="1")
+    np.testing.assert_allclose(seen[-1], ref_seen[-1], rtol=1e-4)
+    np.testing.assert_allclose(learner.w, ref_learner.w,
+                               rtol=1e-3, atol=1e-5)
