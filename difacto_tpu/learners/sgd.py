@@ -66,6 +66,12 @@ class _DeviceBatchCache:
     bounds. Shuffle degrades to a per-epoch permutation of cached batches
     within each part (row->batch assignment is frozen at staging time);
     neg_sampling != 1 disables the cache (each epoch must resample).
+
+    Mesh and multi-host runs cache their staged global (DeviceBatch,
+    slots) pairs ("devbatch" payloads): the epoch-seeded permutation is
+    identical on every host, so replayed epochs rerun the same
+    synchronized collective schedule with zero host->device transfers
+    AND zero DCN control-plane handshakes.
     """
 
     def __init__(self, budget_mb: int, shared: Optional[dict] = None) -> None:
@@ -524,11 +530,17 @@ class SGDLearner(Learner):
         p = self.param
         n_jobs = p.num_jobs_per_epoch if job_type == K_TRAINING else 1
         if self._num_hosts > 1 and self.mesh is not None:
+            cache = self._get_cache(job_type)
+            if cache is not None and cache.ready:
+                self._replay_cached(job_type, epoch, cache, prog)
+                return
             for part in range(n_jobs):
                 before = Progress(nrows=prog.nrows, loss=prog.loss,
                                   auc=prog.auc)
                 self._iterate_data_spmd(job_type, epoch, part, n_jobs, prog)
                 self._report_part(job_type, before, prog)
+            if cache is not None:
+                cache.finish_pass()
             return
         self._iterate_parts(job_type, epoch, n_jobs, prog)
 
@@ -592,6 +604,7 @@ class SGDLearner(Learner):
         from ..updaters.sgd_updater import TRASH_SLOT
 
         p = self.param
+        cache = self._get_cache(job_type)
         push_cnt = (job_type == K_TRAINING and epoch == 0
                     and self.do_embedding)
         g_idx = self._host_rank * num_parts + part_idx
@@ -728,6 +741,18 @@ class SGDLearner(Learner):
                     lo = self._host_rank * b_cap
                     self._save_pred(
                         local_rows(pred, lo, lo + cblk.size), cblk.label)
+            if cache is not None and cache.alive:
+                # stage the global (batch, slots) pair: replayed epochs
+                # rerun the identical synchronized step schedule on every
+                # host with NO DCN handshakes (counts were applied during
+                # this streaming pass, so replays never re-count).
+                # NOTE the budget charges per-HOST resident bytes; the
+                # add() SEQUENCE is still identical across hosts (same
+                # global payloads, same device counts per host on a
+                # uniform mesh), so alive flips in lockstep
+                cache.add(part_idx,
+                          ("devbatch", batch, slots_dev, nrows_g),
+                          self._payload_nbytes((batch, slots_dev)))
             pending.append((nrows_g, objv, auc))
 
         # draining the pending step results blocks on device programs that
@@ -866,12 +891,31 @@ class SGDLearner(Learner):
                                 auc=float(vals[2 * i + 1])))
         return [float(v) for v in vals[2 * len(pending):]]
 
+    @staticmethod
+    def _payload_nbytes(tree) -> int:
+        """ACTUAL per-host HBM held by a (possibly sharded/replicated)
+        payload: replicated leaves cost one copy per addressable device,
+        so mesh cache entries charge what they really pin — global
+        logical nbytes would under-count fs-replicated batch arrays by
+        up to mesh_fs x and blow the device_cache_mb promise."""
+        total = 0
+        for x in jax.tree_util.tree_leaves(tree):
+            shards = getattr(x, "addressable_shards", None)
+            if shards:
+                total += sum(s.data.nbytes for s in shards)
+            else:
+                total += x.nbytes
+        return total
+
     def _get_cache(self, job_type: int) -> Optional[_DeviceBatchCache]:
         """The device replay cache for this job, or None when ineligible
-        (see _DeviceBatchCache docstring for the constraints)."""
+        (see _DeviceBatchCache docstring for the constraints). Mesh and
+        multi-host runs cache their staged global (batch, slots) pairs —
+        replayed steps rerun the SAME synchronized schedule on every
+        host (identical payload counts and epoch-seeded permutations),
+        so the DCN handshakes of the streaming pass disappear too."""
         p = self.param
-        if (p.device_cache_mb <= 0 or self.mesh is not None
-                or self._num_hosts > 1 or not self.store.hashed
+        if (p.device_cache_mb <= 0 or not self.store.hashed
                 or job_type not in (K_TRAINING, K_VALIDATION)
                 or (job_type == K_TRAINING and p.neg_sampling != 1.0)):
             return None
@@ -885,24 +929,32 @@ class SGDLearner(Learner):
 
     def _replay_cached(self, job_type: int, epoch: int,
                        cache: _DeviceBatchCache, prog: Progress) -> None:
-        """Steady-state epoch: replay HBM-resident packed batches — zero
-        host->device transfers, shuffle = per-epoch batch permutation."""
+        """Steady-state epoch: replay HBM-resident staged batches — zero
+        host->device transfers, shuffle = per-epoch batch permutation.
+        Multi-host: every host replays the identical payload sequence
+        (same counts, same epoch-seeded permutation), so the synchronized
+        step schedule holds with no DCN handshakes; the dead-host
+        watchdog stays armed for the collective-bearing steps."""
+        import contextlib
         p = self.param
         is_train = job_type == K_TRAINING
+        guard = (self.monitor.collective() if self.monitor is not None
+                 else contextlib.nullcontext())
         pending: list = []
         cur_part = 0
         before = Progress(nrows=prog.nrows, loss=prog.loss, auc=prog.auc)
-        for part, payload in cache.iter_parts(
-                is_train and p.shuffle > 0, seed=epoch):
-            if part != cur_part:
-                self._merge_pending(pending, prog)
-                pending = []
-                self._report_part(job_type, before, prog)
-                before = Progress(nrows=prog.nrows, loss=prog.loss,
-                                  auc=prog.auc)
-                cur_part = part
-            self._dispatch_packed(job_type, payload, pending)
-        self._final_merge(job_type, pending, prog)
+        with guard:
+            for part, payload in cache.iter_parts(
+                    is_train and p.shuffle > 0, seed=epoch):
+                if part != cur_part:
+                    self._merge_pending(pending, prog)
+                    pending = []
+                    self._report_part(job_type, before, prog)
+                    before = Progress(nrows=prog.nrows, loss=prog.loss,
+                                      auc=prog.auc)
+                    cur_part = part
+                self._dispatch_packed(job_type, payload, pending)
+            self._final_merge(job_type, pending, prog)
         self._report_part(job_type, before, prog)
 
     def _final_merge(self, job_type: int, pending: list, prog: Progress
@@ -1012,6 +1064,17 @@ class SGDLearner(Learner):
         = (layout, i32_dev, f32_dev, b_cap, dim2, u_cap, want_counts,
         binary, has_rm, nrows); dim2 is the panel width or the COO nnz_cap."""
         is_train = job_type == K_TRAINING
+        if payload[0] == "devbatch":
+            # cached replay of a staged mesh/multi-host global batch
+            _, dev, slots, nrows = payload
+            if is_train:
+                self.store.state, objv, auc = self._train_step(
+                    self.store.state, dev, slots)
+            else:
+                _, objv, auc = self._eval_step(self.store.state, dev,
+                                               slots)
+            pending.append((nrows, objv, auc))
+            return
         if payload[0] == "panel_sorted":
             # cached replay fast path (train only): packed panel + the
             # staged sorted-token order
@@ -1151,6 +1214,9 @@ class SGDLearner(Learner):
             else:
                 pred, objv, auc = self._eval_step(self.store.state, dev,
                                                   slots)
+            if cache is not None and cache.alive:
+                cache.add(part, ("devbatch", dev, slots, blk.size),
+                          self._payload_nbytes((dev, slots)))
         if job_type == K_PREDICTION and p.pred_out:
             # stream predictions per batch (SavePred,
             # sgd_learner.cc:231-238) — don't buffer the dataset

@@ -113,6 +113,7 @@ class HeartbeatMonitor:
         self._last_seen = {r: now for r in range(nprocs) if r != rank}
         self._stop = threading.Event()
         self._in_collective_since: Optional[float] = None
+        self._collective_depth = 0
         self._threads = [
             threading.Thread(target=self._send_loop, daemon=True),
             threading.Thread(target=self._recv_loop, daemon=True),
@@ -184,15 +185,21 @@ class HeartbeatMonitor:
 
     def collective(self):
         """Context manager marking a collective in flight for the
-        watchdog."""
+        watchdog. Depth-counted and therefore REENTRANT: an epoch-long
+        outer guard (the cached-replay loop) stays armed when inner
+        guarded() calls exit."""
         mon = self
 
         class _Ctx:
             def __enter__(self):
-                mon._in_collective_since = time.monotonic()
+                mon._collective_depth += 1
+                if mon._collective_depth == 1:
+                    mon._in_collective_since = time.monotonic()
 
             def __exit__(self, *exc):
-                mon._in_collective_since = None
+                mon._collective_depth -= 1
+                if mon._collective_depth == 0:
+                    mon._in_collective_since = None
                 return False
 
         return _Ctx()

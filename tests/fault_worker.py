@@ -1,15 +1,24 @@
 """Fault-injection worker for tests/test_fault.py, run through
 launch.py --max-restarts 1 with 2 processes.
 
-On the first attempt (DIFACTO_RESTART=0), rank 1 kills itself (os._exit)
-in the MIDDLE of epoch 1 — at its 4th DCN allgather, i.e. after epoch 1's
-training batch but before the epoch's termination round — simulating a
-dead host. The survivor's heartbeat watchdog must abort its blocked
-collective (exit 42), after which the launcher evicts a host and
-relaunches a single process that auto-resumes from the epoch-0 checkpoint
-and finishes the run over ALL the data (byte-range re-sharding).
+On the first attempt (DIFACTO_RESTART=0), rank 1 kills itself in the
+MIDDLE of epoch 1, simulating a dead host; two injection modes cover both
+execution regimes:
 
-Usage: fault_worker.py <out_dir> <data_path> [epochs]
+- ``allgather`` (device cache off): dies at its 4th DCN allgather — after
+  epoch 1's training batch but before the epoch's termination round. The
+  survivor's heartbeat watchdog must abort its blocked control-plane
+  collective.
+- ``step`` (device cache on): dies entering its 2nd train step — the
+  first REPLAYED step (epochs 1+ run from the device cache with no DCN
+  handshakes at all). The survivor blocks inside the collective-bearing
+  jitted step; the replay-wide watchdog guard must abort it.
+
+Either way the launcher evicts a host and relaunches a single process
+that auto-resumes from the epoch-0 checkpoint and finishes the run over
+ALL the data (byte-range re-sharding).
+
+Usage: fault_worker.py <out_dir> <data_path> [epochs] [mode]
 """
 import json
 import os
@@ -28,27 +37,33 @@ initialize()
 attempt = os.environ.get("DIFACTO_RESTART", "0")
 rank = jax.process_index()
 
-if rank == 1 and attempt == "0":
+out_dir, data = sys.argv[1], sys.argv[2]
+epochs = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+mode = sys.argv[4] if len(sys.argv) > 4 else "allgather"
+
+
+def _die():
+    print(f"rank {rank}: simulating host death", flush=True)
+    # die by signal, like a real dead host (OOM-kill / machine loss); the
+    # launcher only restarts on signal death or a peer-dead exit code — a
+    # plain rc=1 is a config error, not a fault
+    import signal
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+if rank == 1 and attempt == "0" and mode == "allgather":
     import difacto_tpu.parallel.multihost as mh
     _orig, _calls = mh.allgather_np, {"n": 0}
 
     def _dying_allgather(arr):
         _calls["n"] += 1
         if _calls["n"] == 4:  # epoch 1, after its train batch: mid-epoch
-            print(f"rank {rank}: simulating host death", flush=True)
-            # die by signal, like a real dead host (OOM-kill / machine
-            # loss); the launcher only restarts on signal death or
-            # EXIT_PEER_DEAD — a plain rc=1 is a config error, not a fault
-            import signal
-            os.kill(os.getpid(), signal.SIGKILL)
+            _die()
         return _orig(arr)
 
     mh.allgather_np = _dying_allgather
 
 from difacto_tpu.learners import Learner  # noqa: E402
-
-out_dir, data = sys.argv[1], sys.argv[2]
-epochs = int(sys.argv[3]) if len(sys.argv) > 3 else 4
 
 nprocs = jax.process_count()
 ln = Learner.create("sgd")
@@ -61,7 +76,30 @@ ln.init([("data_in", data), ("V_dim", "2"), ("V_threshold", "2"),
          ("hash_capacity", str(1 << 20)),
          ("mesh_dp", str(nprocs)), ("mesh_fs", "4"),
          ("ckpt_interval", "1"), ("auto_resume", "1"),
+         ("device_cache_mb", "0" if mode == "allgather" else "2048"),
          ("model_out", os.path.join(out_dir, "model"))])
+
+if rank == 1 and attempt == "0" and mode == "step":
+    from difacto_tpu.learners.sgd import K_TRAINING
+    _orig_step, _calls = ln._train_step, {"n": 0}
+
+    def _dying_step(*a, **kw):
+        _calls["n"] += 1
+        if _calls["n"] == 2:  # the first REPLAYED step (epoch 1)
+            # this mode exists to exercise the replay-wide watchdog
+            # guard: fail LOUDLY (non-recovery rc) if batch geometry
+            # drift means this is not actually a replayed step
+            cache = ln._dev_caches.get(K_TRAINING)
+            if cache is None or not cache.ready:
+                print("fault_worker: step-mode kill fired during a "
+                      "STREAMED step — replay path not covered; fix the "
+                      "kill trigger", flush=True)
+                os._exit(3)
+            _die()
+        return _orig_step(*a, **kw)
+
+    ln._train_step = _dying_step
+
 seen = []
 ln.add_epoch_end_callback(lambda e, t, v: seen.append((e, t.loss)))
 

@@ -160,3 +160,33 @@ def test_panel_replay_sorted_backward(tmp_path):
     payloads = [pl for items in cache.entries.values() for pl in items]
     assert payloads and all(pl[0] == "panel_sorted" for pl in payloads)
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_mesh_replay_matches_streaming(rcv1_path):
+    """Single-controller mesh path: staged global (DeviceBatch, slots)
+    pairs replay epochs 1+ with no re-staging, reproducing the streamed
+    trajectory (shuffle off)."""
+    def run(cache_mb):
+        args = [("data_in", rcv1_path), ("data_format", "libsvm"),
+                ("loss", "fm"), ("V_dim", "2"), ("V_threshold", "0"),
+                ("lr", "0.1"), ("l1", "0.1"), ("l2", "0"),
+                ("batch_size", "25"), ("shuffle", "0"),
+                ("max_num_epochs", "5"), ("num_jobs_per_epoch", "1"),
+                ("report_interval", "0"), ("stop_rel_objv", "0"),
+                ("hash_capacity", str(1 << 14)),
+                ("mesh_dp", "2"), ("mesh_fs", "4"),
+                ("device_cache_mb", str(cache_mb))]
+        learner = Learner.create("sgd")
+        learner.init(args)
+        seen = []
+        learner.add_epoch_end_callback(lambda e, t, v: seen.append(t.loss))
+        learner.run()
+        return np.array(seen), learner
+
+    ref, _ = run(0)
+    got, learner = run(256)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    cache = learner._dev_caches[K_TRAINING]
+    assert cache.ready
+    payloads = [pl for items in cache.entries.values() for pl in items]
+    assert payloads and all(pl[0] == "devbatch" for pl in payloads)

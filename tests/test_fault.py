@@ -26,17 +26,23 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 EPOCHS = 4
 
 
-def test_kill_one_host_mid_epoch_recovers(rcv1_path, tmp_path):
+@pytest.mark.parametrize("mode,port", [("allgather", 7941), ("step", 7945)])
+def test_kill_one_host_mid_epoch_recovers(rcv1_path, tmp_path, mode, port):
+    """Both execution regimes: ``allgather`` kills rank 1 at a streamed
+    epoch's DCN handshake; ``step`` kills it entering the first REPLAYED
+    train step (device cache on, no DCN calls) — the survivor must be
+    freed by the replay-wide watchdog guard instead."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets its own 4-device flag
     env["PYTHONPATH"] = str(REPO)
     env["DIFACTO_HB_TIMEOUT"] = "2"  # overridden timeout: fast test
     proc = subprocess.run(
         [sys.executable, str(REPO / "launch.py"), "-n", "2",
-         "--port", "7941", "--max-restarts", "1",
-         "--hb-port", "29990", "--hb-timeout", "2", "--",
+         "--port", str(port), "--max-restarts", "1",
+         "--hb-port", "29990" if mode == "allgather" else "29930",
+         "--hb-timeout", "2", "--",
          sys.executable, str(REPO / "tests" / "fault_worker.py"),
-         str(tmp_path), rcv1_path, str(EPOCHS)],
+         str(tmp_path), rcv1_path, str(EPOCHS), mode],
         cwd=str(REPO), env=env, capture_output=True, text=True, timeout=540)
     assert proc.returncode == 0, f"stdout:\n{proc.stdout}\n" \
                                  f"stderr:\n{proc.stderr}"
