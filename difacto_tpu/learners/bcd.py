@@ -36,11 +36,11 @@ import numpy as np
 from ..base import FEAID_DTYPE, encode_fea_grp_id, decode_fea_grp_id, \
     reverse_bytes
 from ..config import KWArgs, Param
-from ..data import Reader, compact
+from ..data import Reader
 from ..losses.logit_delta import BlockSlice as _BlockSlice
 from ..losses.metrics import accuracy_times_n, auc_times_n, logit_objv_np
 from ..ops.batch import bucket
-from ..ops.kv import expand_ranges, find_position, kv_union
+from ..ops.kv import expand_ranges, find_position
 from .base import Learner, register
 
 log = logging.getLogger("difacto_tpu")
@@ -225,12 +225,11 @@ class BCDLearner(Learner):
 
     # ----------------------------------------------------------- data prep
     def _prepare(self) -> None:
+        from ..data.tile_builder import TileBuilder
         p, up = self.param, self.uparam
-        # read + localize all tiles (PrepareData, bcd_learner.cc:96-132)
-        raw = []
-        ids = np.empty(0, dtype=FEAID_DTYPE)
-        cnts = np.empty(0, dtype=np.float32)
-        self.ntrain = self.nval = 0
+        # read + localize all tiles through the shared TileBuilder
+        # (PrepareData, bcd_learner.cc:96-132)
+        tb = TileBuilder()
         # stats accumulate per block so raw text blocks are dropped as we go
         # (the reference streams via TileBuilder the same way)
         stats = np.zeros((1 << p.num_feature_group_bits) + 2,
@@ -238,20 +237,16 @@ class BCDLearner(Learner):
         for blk in Reader(p.data_in, p.data_format,
                           chunk_bytes=p.data_chunk_size):
             add_group_stats(stats, blk, p.num_feature_group_bits)
-            cblk, uniq, cnt = compact(blk, need_counts=True)
-            raw.append((cblk, uniq, True))
-            ids, cnts = kv_union(ids, cnts, uniq, cnt.astype(np.float32))
-            self.ntrain += blk.size
+            tb.add(blk, is_train=True)
         if p.data_val:
             for blk in Reader(p.data_val, p.data_format,
                               chunk_bytes=p.data_chunk_size):
-                cblk, uniq, _ = compact(blk)
-                raw.append((cblk, uniq, False))
-                self.nval += blk.size
+                tb.add(blk, is_train=False)
+        self.ntrain, self.nval = tb.nrows_train, tb.nrows_val
 
-        # tail filter (BuildFeatureMap, bcd_learner.cc:141-155)
-        keep = cnts > up.tail_feature_filter
-        self.feaids = ids[keep]
+        # tail filter (BuildFeatureMap, bcd_learner.cc:141-155); the
+        # reference filters with cnt > threshold via the builder
+        self.feaids = tb.filter_tail(up.tail_feature_filter)
         nf = len(self.feaids)
 
         # partition feature blocks (RunScheduler, bcd_learner.cc:60-72)
@@ -284,8 +279,8 @@ class BCDLearner(Learner):
         from ..ops.batch import mesh_dim_min
         dim_min = 8 if self.mesh is None else mesh_dim_min(p.mesh_dp)
         self.tiles = []
-        for cblk, uniq, is_train in raw:
-            colmap = find_position(self.feaids, uniq)
+        for t, (cblk, uniq, is_train) in enumerate(tb.tiles):
+            colmap = tb.colmap(t)
             col_global = colmap[cblk.index]  # -1 where filtered
             b_cap = bucket(cblk.size, dim_min)
             labels = np.zeros(b_cap, dtype=np.float32)

@@ -38,11 +38,11 @@ import numpy as np
 
 from ..base import FEAID_DTYPE
 from ..config import KWArgs, Param
-from ..data import Reader, compact
+from ..data import Reader
 from ..losses import FMParams, fm_grad, fm_predict, logit_objv
 from ..losses.metrics import auc_times_n_jnp
 from ..ops.batch import DeviceBatch, bucket, pad_batch
-from ..ops.kv import expand_ranges, find_position, kv_union
+from ..ops.kv import expand_ranges, find_position
 from .base import Learner, register
 
 log = logging.getLogger("difacto_tpu")
@@ -160,40 +160,34 @@ class LBFGSLearner(Learner):
 
     # ----------------------------------------------------------- data prep
     def _prepare_data(self) -> None:
-        """PrepareData (lbfgs_learner.cc:146-194): read once, localize, keep
-        per-chunk compact blocks + accumulate the global (id, count) dict."""
+        """PrepareData (lbfgs_learner.cc:146-194): read once through the
+        shared TileBuilder — localize each chunk, keep the compact blocks,
+        accumulate the global (id, count) dictionary."""
+        from ..data.tile_builder import TileBuilder
         p = self.param
         chunk = int(p.data_chunk_size * (1 << 20))
-        ids = np.empty(0, dtype=FEAID_DTYPE)
-        cnts = np.empty(0, dtype=np.float32)
-        self._raw_train = []
-        self._raw_val = []
-        self.ntrain = self.nval = 0
-        self.train_nnz = 0
+        tb = TileBuilder()
         for blk in Reader(p.data_in, p.data_format, chunk_bytes=chunk):
-            cblk, uniq, cnt = compact(blk, need_counts=True)
-            self._raw_train.append((cblk, uniq))
-            ids, cnts = kv_union(ids, cnts, uniq, cnt.astype(np.float32))
-            self.ntrain += blk.size
-            self.train_nnz += blk.nnz
+            tb.add(blk, is_train=True)
         if p.data_val:
             for blk in Reader(p.data_val, p.data_format, chunk_bytes=chunk):
-                cblk, uniq, _ = compact(blk)
-                self._raw_val.append((cblk, uniq))
-                self.nval += blk.size
-        self.feaids, self.feacnts = ids, cnts
+                tb.add(blk, is_train=False)
+        self._builder = tb
+        self._raw_train = [(cb, u) for cb, u, t in tb.tiles if t]
+        self._raw_val = [(cb, u) for cb, u, t in tb.tiles if not t]
+        self.ntrain, self.nval = tb.nrows_train, tb.nrows_val
+        self.train_nnz = tb.nnz_train
+        self.feaids, self.feacnts = tb.ids, tb.cnts
         log.info("found %d training examples, %d features",
-                 self.ntrain, len(ids))
+                 self.ntrain, len(tb.ids))
 
     def _init_model(self) -> float:
         """InitServer + InitWorker (lbfgs_updater.h:35-77,
         lbfgs_learner.cc:196-219): tail filter, [w, V...] layout, V init.
         Returns r(w0); also builds tiles and the regularizer vector."""
         up = self.uparam
-        if up.tail_feature_filter > 0:
-            keep = self.feacnts > up.tail_feature_filter
-            self.feaids = self.feaids[keep]
-            self.feacnts = self.feacnts[keep]
+        self.feaids = self._builder.filter_tail(up.tail_feature_filter)
+        self.feacnts = self._builder.cnts
         nf = len(self.feaids)
         if up.V_dim > 0:
             lens = 1 + np.where(self.feacnts > up.V_threshold, up.V_dim, 0)

@@ -73,3 +73,39 @@ def test_bcd_with_bounded_tile_cache(rcv1_path):
     # the bounded cache must rebuild evicted tiles; unlimited builds once
     assert learner._tile_cache.misses > unlimited._tile_cache.misses
     assert len(learner._tile_cache) == 1
+
+
+def test_tile_builder_shared():
+    """data/tile_builder.py (the shared TileBuilder, tile_builder.h:17-183):
+    dictionary accumulation across tiles, tail filter, colmaps."""
+    import numpy as np
+    from difacto_tpu.data.rowblock import RowBlock
+    from difacto_tpu.data.tile_builder import TileBuilder
+
+    def blk(ids, label=1.0):
+        return RowBlock(offset=np.array([0, len(ids)], dtype=np.int64),
+                        label=np.array([label], dtype=np.float32),
+                        index=np.array(ids, dtype=np.uint64))
+
+    tb = TileBuilder()
+    tb.add(blk([5, 7, 9]))
+    tb.add(blk([7, 11]))
+    tb.add(blk([5, 13]), is_train=False)  # val ids never enter the dict
+    assert tb.nrows_train == 2 and tb.nrows_val == 1
+    # dictionary is the union of TRAIN ids with summed counts; compact
+    # stores ids byte-reversed (Localizer's uniform-keyspace trick), so
+    # map back before comparing
+    from difacto_tpu.base import reverse_bytes
+    fwd = {int(reverse_bytes(np.uint64(x))): i
+           for i, x in enumerate(tb.ids)}
+    assert set(fwd) == {5, 7, 9, 11}
+    assert tb.cnts[fwd[7]] == 2 and tb.cnts[fwd[5]] == 1
+
+    # tail filter keeps count > 1 only
+    kept = tb.filter_tail(1)
+    assert [int(reverse_bytes(np.uint64(x))) for x in kept] == [7]
+    # colmaps: tile 0's uniq [5,7,9] -> only 7 maps; val tile's 5 filtered
+    cm0 = tb.colmap(0)
+    assert (cm0 >= 0).sum() == 1
+    cm2 = tb.colmap(2)
+    assert (cm2 >= 0).sum() == 0  # val tile held {5, 13}, both filtered
