@@ -167,8 +167,26 @@ class BCDLearner(Learner):
         if self.uparam.V_dim != 0:
             raise ValueError("bcd supports V_dim=0 only (linear model), like "
                              "the reference (bcd_updater.h InitWeights)")
+        # multi-host: each host holds its byte range's row tiles; per-block
+        # (g, h) partials meet in a DCN allreduce and every host applies
+        # the identical diag-Newton update — the reference's workers
+        # pushing partial block gradients that the servers sum
+        # (src/bcd/bcd_learner.cc:236-263)
+        self._num_hosts = jax.process_count()
+        self._host_rank = jax.process_index()
+        from ..parallel import fault
+        self.monitor = fault.from_env(self._host_rank, self._num_hosts)
+        if self._num_hosts > 1 and self.param.mesh_dp > 1:
+            raise ValueError(
+                "bcd multi-host runs shard rows across hosts; in-host row "
+                "sharding (mesh_dp > 1) is single-host only — set "
+                "mesh_dp=1 under launch.py")
         self._build_steps()
         return remain
+
+    def _allreduce_np(self, buf: np.ndarray, sum_dtype=None) -> np.ndarray:
+        from ..parallel.multihost import allreduce_np
+        return allreduce_np(buf, self.monitor, sum_dtype=sum_dtype)
 
     def _build_steps(self) -> None:
         from ..losses.logit_delta import delta_grad, delta_pred_update
@@ -229,20 +247,34 @@ class BCDLearner(Learner):
         p, up = self.param, self.uparam
         # read + localize all tiles through the shared TileBuilder
         # (PrepareData, bcd_learner.cc:96-132)
+        part_idx, num_parts = 0, 1
+        if self._num_hosts > 1:
+            from ..parallel.multihost import host_part
+            part_idx, num_parts = host_part()
         tb = TileBuilder()
         # stats accumulate per block so raw text blocks are dropped as we go
         # (the reference streams via TileBuilder the same way)
         stats = np.zeros((1 << p.num_feature_group_bits) + 2,
                          dtype=np.float64)
-        for blk in Reader(p.data_in, p.data_format,
+        for blk in Reader(p.data_in, p.data_format, part_idx, num_parts,
                           chunk_bytes=p.data_chunk_size):
             add_group_stats(stats, blk, p.num_feature_group_bits)
             tb.add(blk, is_train=True)
         if p.data_val:
-            for blk in Reader(p.data_val, p.data_format,
-                              chunk_bytes=p.data_chunk_size):
+            for blk in Reader(p.data_val, p.data_format, part_idx,
+                              num_parts, chunk_bytes=p.data_chunk_size):
                 tb.add(blk, is_train=False)
         self.ntrain, self.nval = tb.nrows_train, tb.nrows_val
+        if self._num_hosts > 1:
+            # global dictionary + group stats + row totals: the feature
+            # partition and the tail filter must be identical on every
+            # host (BuildFeatureMap, bcd_learner.cc:141-155)
+            from ..parallel.multihost import global_kv_union
+            tb.ids, tb.cnts = global_kv_union(tb.ids, tb.cnts)
+            stats = self._allreduce_np(stats)
+            tot = self._allreduce_np(np.array([self.ntrain, self.nval],
+                                              dtype=np.int64))
+            self.ntrain, self.nval = int(tot[0]), int(tot[1])
 
         # tail filter (BuildFeatureMap, bcd_learner.cc:141-155); the
         # reference filters with cnt > threshold via the builder
@@ -375,9 +407,19 @@ class BCDLearner(Learner):
             g = g + dg
             h = h + dh
 
+        if self._num_hosts > 1:
+            # per-block partial (g, h) -> global sums over DCN (float32
+            # wire, float64 accumulation); all hosts then apply the
+            # identical update
+            buf = np.concatenate([np.asarray(g), np.asarray(h)])
+            s = self._allreduce_np(buf, sum_dtype=np.float64)
+            g_np = s[:nf_blk]
+            h_np = s[nf_cap:nf_cap + nf_blk]
+        else:
+            g_np = np.asarray(g)[:nf_blk].astype(np.float64)
+            h_np = np.asarray(h)[:nf_blk].astype(np.float64)
+
         # diag-Newton + trust region (UpdateWeight, bcd_updater.h:139-159)
-        g_np = np.asarray(g)[:nf_blk].astype(np.float64)
-        h_np = np.asarray(h)[:nf_blk].astype(np.float64)
         w = self.w[b_lo:b_hi].astype(np.float64)
         dlt = self.delta[b_lo:b_hi]
         g_pos, g_neg = g_np + up.l1, g_np - up.l1
@@ -410,6 +452,9 @@ class BCDLearner(Learner):
             objv += logit_objv_np(lab, pred)
             auc += auc_times_n(lab, pred)
             acc += accuracy_times_n(lab, pred, 0.5)
+        if self._num_hosts > 1:
+            count, objv, auc, acc = (float(v) for v in self._allreduce_np(
+                np.array([count, objv, auc, acc], dtype=np.float64)))
         return BCDProgress(count=count, objv=objv, auc=auc, acc=acc,
                            nnz_w=float(np.sum(self.w != 0)))
 

@@ -133,7 +133,23 @@ class LBFGSLearner(Learner):
         if self.param.loss == "logit":
             self.uparam = dataclasses.replace(self.uparam, V_dim=0)
         self.k = self.uparam.V_dim
+        # multi-host: each host reads its byte range and accumulates
+        # partial (objv, auc, grad) over its local tiles; the raw sums
+        # meet in a DCN allreduce — the reference's workers pushing
+        # partial gradients that the servers sum
+        # (src/lbfgs/lbfgs_learner.cc:121-125). All hosts then run the
+        # identical two-loop/Wolfe math on identical inputs.
+        self._num_hosts = jax.process_count()
+        self._host_rank = jax.process_index()
+        # dead-host detection for the DCN reductions (parallel/fault.py)
+        from ..parallel import fault
+        self.monitor = fault.from_env(self._host_rank, self._num_hosts)
         self.mesh = None
+        if self.param.mesh_fs > 1 and self._num_hosts > 1:
+            raise ValueError(
+                "lbfgs multi-host runs shard DATA across hosts; in-host "
+                "vector sharding (mesh_fs > 1) is single-host only — "
+                "set mesh_fs=1 under launch.py")
         if self.param.mesh_fs > 1:
             from jax.sharding import NamedSharding, PartitionSpec
             from ..parallel import make_mesh
@@ -166,20 +182,40 @@ class LBFGSLearner(Learner):
         from ..data.tile_builder import TileBuilder
         p = self.param
         chunk = int(p.data_chunk_size * (1 << 20))
+        part_idx, num_parts = 0, 1
+        if self._num_hosts > 1:
+            from ..parallel.multihost import host_part
+            part_idx, num_parts = host_part()
         tb = TileBuilder()
-        for blk in Reader(p.data_in, p.data_format, chunk_bytes=chunk):
+        for blk in Reader(p.data_in, p.data_format, part_idx, num_parts,
+                          chunk_bytes=chunk):
             tb.add(blk, is_train=True)
         if p.data_val:
-            for blk in Reader(p.data_val, p.data_format, chunk_bytes=chunk):
+            for blk in Reader(p.data_val, p.data_format, part_idx,
+                              num_parts, chunk_bytes=chunk):
                 tb.add(blk, is_train=False)
         self._builder = tb
         self._raw_train = [(cb, u) for cb, u, t in tb.tiles if t]
         self._raw_val = [(cb, u) for cb, u, t in tb.tiles if not t]
         self.ntrain, self.nval = tb.nrows_train, tb.nrows_val
         self.train_nnz = tb.nnz_train
+        if self._num_hosts > 1:
+            self._merge_global_dict(tb)
         self.feaids, self.feacnts = tb.ids, tb.cnts
         log.info("found %d training examples, %d features",
                  self.ntrain, len(tb.ids))
+
+    def _merge_global_dict(self, tb) -> None:
+        """Union the per-host dictionaries so every host lays out the
+        IDENTICAL global [w, V...] vector (the reference's servers own a
+        global key space; InitServer, lbfgs_updater.h:35-56); row/nnz
+        totals sum (int64-safe: criteo-scale nnz exceeds int32)."""
+        from ..parallel.multihost import allreduce_np, global_kv_union
+        tb.ids, tb.cnts = global_kv_union(tb.ids, tb.cnts)
+        tot = allreduce_np(np.array(
+            [self.ntrain, self.nval, self.train_nnz], dtype=np.int64),
+            self.monitor)
+        self.ntrain, self.nval, self.train_nnz = (int(t) for t in tot)
 
     def _init_model(self) -> float:
         """InitServer + InitWorker (lbfgs_updater.h:35-77,
@@ -387,7 +423,10 @@ class LBFGSLearner(Learner):
         self._nnz = jax.jit(lambda w: jnp.sum(w != 0))
 
     def _calc_grad(self, weights):
-        """f(w), train auc, loss gradient — one pass over train tiles."""
+        """f(w), train auc, loss gradient — one pass over the LOCAL train
+        tiles; multi-host sums the raw partials over DCN before
+        finish_grad (the gamma transform is nonlinear, so the reduction
+        must precede it)."""
         grad = self._put_vec(jnp.zeros(self.N_pad, dtype=jnp.float32))
         objv = 0.0
         auc = 0.0
@@ -395,6 +434,16 @@ class LBFGSLearner(Learner):
             o, a, grad = self._tile_grad(weights, grad, tile)
             objv += float(o)
             auc += float(a)
+        if self._num_hosts > 1:
+            from ..parallel.multihost import allreduce_np
+            # scalars ride a float64-safe wire; the gradient gathers as
+            # float32 (half the wire bytes) and sums in float64
+            scal = allreduce_np(np.array([objv, auc], dtype=np.float64),
+                                self.monitor)
+            objv, auc = float(scal[0]), float(scal[1])
+            g = allreduce_np(np.asarray(grad), self.monitor,
+                             sum_dtype=np.float64)
+            grad = self._put_vec(g.astype(np.float32))
         return objv, auc, self._finish_grad(grad, self._n_real)
 
     # ----------------------------------------------------------- driver
@@ -484,6 +533,10 @@ class LBFGSLearner(Learner):
             val_auc = 0.0
             for tile in self._iter_tiles("val"):
                 val_auc += float(self._tile_pred_auc(self.weights, tile))
+            if self._num_hosts > 1 and self.nval:
+                from ..parallel.multihost import allreduce_np
+                val_auc = float(allreduce_np(
+                    np.array([val_auc], dtype=np.float64), self.monitor)[0])
             prog = LBFGSProgress(
                 objv=new_objv,
                 auc=auc / max(self.ntrain, 1),

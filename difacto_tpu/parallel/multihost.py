@@ -122,3 +122,58 @@ def host_part() -> Tuple[int, int]:
         return jax.process_index(), jax.process_count()
     except RuntimeError:
         return 0, 1
+
+
+def global_kv_union(ids, cnts):
+    """Union per-host sorted-unique (id, count) dictionaries across all
+    processes: counts sum, ids union (the reference's servers own one
+    global key space). uint64 ids ride the DCN gather as uint32 pairs —
+    process_allgather goes through jax, which silently truncates uint64
+    with x64 disabled. Single process: returns the inputs."""
+    import numpy as np
+
+    from ..ops.kv import kv_union
+    sizes = allgather_np(np.array([len(ids)], dtype=np.int32))[:, 0]
+    cap = int(sizes.max())
+    ids_p = np.zeros(cap, dtype=np.uint64)
+    ids_p[:len(ids)] = ids
+    cnt_p = np.zeros(cap, dtype=np.float32)
+    cnt_p[:len(cnts)] = cnts
+    all_ids = allgather_np(ids_p.view(np.uint32))
+    all_cnt = allgather_np(cnt_p)
+    out_ids = np.empty(0, dtype=ids.dtype)
+    out_cnt = np.empty(0, dtype=np.float32)
+    for h in range(len(sizes)):
+        k = int(sizes[h])
+        h_ids = np.ascontiguousarray(
+            all_ids[h]).view(np.uint64)[:k].astype(ids.dtype)
+        out_ids, out_cnt = kv_union(out_ids, out_cnt, h_ids, all_cnt[h, :k])
+    return out_ids, out_cnt
+
+
+def allreduce_np(buf, monitor=None, sum_dtype=None):
+    """Sum a host array across all processes over DCN.
+
+    64-bit dtypes ride the wire as uint32 views — the jax transport
+    canonicalizes 64-bit to 32-bit with x64 disabled, which would
+    silently truncate them (same hazard global_kv_union guards for ids).
+    ``sum_dtype`` widens the host-side summation (e.g. gather float32
+    partials, accumulate in float64). ``monitor`` arms the dead-host
+    watchdog around the collective (parallel/fault.py).
+
+    This is allgather-based (every host materializes [n_hosts, len]); at
+    very large vector sizes a device psum over a global mesh would halve
+    the wire cost, but the control plane deliberately avoids requiring a
+    collective mesh.
+    """
+    import numpy as np
+    buf = np.ascontiguousarray(buf)
+    wide = buf.dtype.itemsize == 8
+    wire = buf.view(np.uint32) if wide else buf
+    if monitor is not None:
+        g = monitor.guarded(allgather_np, wire)
+    else:
+        g = allgather_np(wire)
+    if wide:
+        g = np.ascontiguousarray(g).view(buf.dtype)
+    return g.sum(axis=0, dtype=sum_dtype)

@@ -1,0 +1,34 @@
+"""Multi-host BCD worker for tests/test_multihost_bcd.py (run through
+launch.py): each process holds its byte range's row tiles; per-block
+(g, h) partials meet in the DCN allreduce and every host applies the
+identical diag-Newton update. Writes its per-epoch objective trajectory."""
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from difacto_tpu.parallel.multihost import initialize  # noqa: E402
+
+initialize()
+
+from difacto_tpu.learners import Learner  # noqa: E402
+
+out_dir, data = sys.argv[1], sys.argv[2]
+rank = jax.process_index()
+
+ln = Learner.create("bcd")
+ln.init([("data_in", data), ("l1", ".1"), ("lr", ".05"),
+         ("block_ratio", "0.001"), ("tail_feature_filter", "0"),
+         ("max_num_epochs", "10")])
+seen = []
+ln.add_epoch_end_callback(lambda e, p: seen.append(p.objv))
+ln.run()
+
+with open(os.path.join(out_dir, f"traj-{rank}.json"), "w") as f:
+    json.dump(seen, f)
+print(f"rank {rank} done")

@@ -1,0 +1,34 @@
+"""Multi-host L-BFGS worker for tests/test_multihost_lbfgs.py (run through
+launch.py): each process reads its byte range, partial (objv, auc, grad)
+sums meet in the DCN allreduce, and every host runs identical two-loop /
+Wolfe math. Writes its per-epoch objective trajectory as JSON."""
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from difacto_tpu.parallel.multihost import initialize  # noqa: E402
+
+initialize()
+
+from difacto_tpu.learners import Learner  # noqa: E402
+
+out_dir, data = sys.argv[1], sys.argv[2]
+rank = jax.process_index()
+
+ln = Learner.create("lbfgs")
+ln.init([("data_in", data), ("m", "5"), ("V_dim", "0"), ("l2", "0"),
+         ("init_alpha", "1"), ("tail_feature_filter", "0"),
+         ("max_num_epochs", "19")])
+seen = []
+ln.add_epoch_end_callback(lambda e, prog: seen.append(prog.objv))
+ln.run()
+
+with open(os.path.join(out_dir, f"traj-{rank}.json"), "w") as f:
+    json.dump(seen, f)
+print(f"rank {rank} done")
