@@ -48,7 +48,7 @@ K_LOAD_MODEL, K_SAVE_MODEL, K_TRAINING, K_VALIDATION, K_PREDICTION, \
 
 
 class _DeviceBatchCache:
-    """Device-resident replay cache for the packed single-host hashed path.
+    """Device-resident replay cache for staged batches (all store modes).
 
     Host->device transfer through a tunneled/remote chip measures ~5-10 MB/s
     while the fused step consumes packed batches far faster — steady-state
@@ -60,12 +60,17 @@ class _DeviceBatchCache:
     (src/data/tile_store.h:32-168) — here the cached unit is the packed,
     already-localized device batch.
 
-    Only the hashed store qualifies: its capacity is fixed, so cached slot
-    vectors (including their out-of-bounds padding) stay truthful forever;
-    the dictionary store can grow, which would pull padded indices back in
-    bounds. Shuffle degrades to a per-epoch permutation of cached batches
-    within each part (row->batch assignment is frozen at staging time);
-    neg_sampling != 1 disables the cache (each epoch must resample).
+    The hashed store stages on its FIRST pass: its capacity is fixed, so
+    cached slot vectors (including their out-of-bounds padding) stay
+    truthful forever. The dictionary store can GROW, which would pull
+    padded indices back in bounds — it stages on its SECOND pass
+    (``stage_after_pass=1``): one full pass over fixed data inserts every
+    feature, so the dictionary is complete and the capacity frozen; a
+    capacity change after staging (impossible for fixed data, guarded
+    anyway) invalidates the cache back to streaming. Shuffle degrades to
+    a per-epoch permutation of cached batches within each part
+    (row->batch assignment is frozen at staging time); neg_sampling != 1
+    disables the cache (each epoch must resample).
 
     Mesh and multi-host runs cache their staged global (DeviceBatch,
     slots) pairs ("devbatch" payloads): the epoch-seeded permutation is
@@ -74,7 +79,8 @@ class _DeviceBatchCache:
     AND zero DCN control-plane handshakes.
     """
 
-    def __init__(self, budget_mb: int, shared: Optional[dict] = None) -> None:
+    def __init__(self, budget_mb: int, shared: Optional[dict] = None,
+                 stage_after_pass: int = 0) -> None:
         """``shared`` is a mutable ``{"used": bytes}`` pool: all caches of
         one learner (training + validation) draw from the SAME
         device_cache_mb budget, so actual HBM held never exceeds the
@@ -83,26 +89,46 @@ class _DeviceBatchCache:
         self.shared = shared if shared is not None else {"used": 0}
         self.used = 0
         self.entries: dict = {}   # part -> list of payload tuples
-        self.ready = False        # becomes True after one full pass
+        self.ready = False        # True once a staging pass completed
         self.alive = True
+        self.stage_after_pass = stage_after_pass
+        self.passes = 0
+        self.capacity: Optional[int] = None  # store capacity at staging
 
-    def add(self, part: int, payload, nbytes: int) -> None:
-        if not self.alive:
+    @property
+    def staging(self) -> bool:
+        """True while the CURRENT pass should stage payloads."""
+        return self.alive and self.passes == self.stage_after_pass
+
+    def invalidate(self, reason: str) -> None:
+        self.alive = False
+        self.ready = False
+        self.entries.clear()
+        self.shared["used"] -= self.used
+        self.used = 0
+        log.info("device batch cache invalidated (%s) — streaming", reason)
+
+    def add(self, part: int, payload, nbytes: int,
+            capacity: Optional[int] = None) -> None:
+        if not self.staging:
             return
+        if capacity is not None:
+            if self.capacity is None:
+                self.capacity = capacity
+            elif self.capacity != capacity:
+                self.invalidate("store capacity grew during staging")
+                return
         self.used += nbytes
         self.shared["used"] += nbytes
         if self.shared["used"] > self.budget:
-            self.alive = False
-            self.entries.clear()
-            self.shared["used"] -= self.used
-            log.info("device batch cache over budget (%d MB total) — "
-                     "streaming", self.budget >> 20)
+            self.invalidate(f"over budget ({self.budget >> 20} MB total)")
             return
         self.entries.setdefault(part, []).append(payload)
 
     def finish_pass(self) -> None:
-        if self.alive:
+        if self.alive and self.passes == self.stage_after_pass:
             self.ready = True
+        self.passes += 1
 
     def iter_parts(self, shuffle: bool, seed: int):
         rng = np.random.RandomState(seed)
@@ -741,7 +767,7 @@ class SGDLearner(Learner):
                     lo = self._host_rank * b_cap
                     self._save_pred(
                         local_rows(pred, lo, lo + cblk.size), cblk.label)
-            if cache is not None and cache.alive:
+            if cache is not None and cache.staging:
                 # stage the global (batch, slots) pair: replayed epochs
                 # rerun the identical synchronized step schedule on every
                 # host with NO DCN handshakes (counts were applied during
@@ -915,7 +941,7 @@ class SGDLearner(Learner):
         host (identical payload counts and epoch-seeded permutations),
         so the DCN handshakes of the streaming pass disappear too."""
         p = self.param
-        if (p.device_cache_mb <= 0 or not self.store.hashed
+        if (p.device_cache_mb <= 0
                 or job_type not in (K_TRAINING, K_VALIDATION)
                 or (job_type == K_TRAINING and p.neg_sampling != 1.0)):
             return None
@@ -923,8 +949,12 @@ class SGDLearner(Learner):
             self._dev_caches = {}
             self._dev_cache_pool = {"used": 0}  # one budget across jobs
         if job_type not in self._dev_caches:
+            # dictionary stores stage on their SECOND pass (the first
+            # pass completes the dictionary and freezes capacity — see
+            # the _DeviceBatchCache docstring)
             self._dev_caches[job_type] = _DeviceBatchCache(
-                p.device_cache_mb, shared=self._dev_cache_pool)
+                p.device_cache_mb, shared=self._dev_cache_pool,
+                stage_after_pass=0 if self.store.hashed else 1)
         return self._dev_caches[job_type]
 
     def _replay_cached(self, job_type: int, epoch: int,
@@ -981,8 +1011,15 @@ class SGDLearner(Learner):
         p = self.param
         cache = self._get_cache(job_type)
         if cache is not None and cache.ready:
-            self._replay_cached(job_type, epoch, cache, prog)
-            return
+            if (cache.capacity is not None
+                    and cache.capacity != self.store.state.capacity):
+                # staged slot padding is only truthful at the staging
+                # capacity (pad_slots_oob) — impossible for fixed data,
+                # guarded anyway
+                cache.invalidate("store capacity changed since staging")
+            else:
+                self._replay_cached(job_type, epoch, cache, prog)
+                return
         push_cnt = (job_type == K_TRAINING and epoch == 0
                     and self.do_embedding)
         from ..ops.batch import mesh_dim_min
@@ -1126,7 +1163,7 @@ class SGDLearner(Learner):
             layout, i32, f32, binary, b_cap, d2, u_cap, has_rm = payload
             i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
             wc = want_counts if is_train else False
-            staging = (cache is not None and cache.alive
+            staging = (cache is not None and cache.staging
                        and layout == "panel" and is_train)
             if staging:
                 # cache-eligible panel training: sort ONCE at staging time
@@ -1143,7 +1180,7 @@ class SGDLearner(Learner):
                                binary, has_rm, blk.size)
             self._dispatch_packed(job_type, dev_payload, pending,
                                   label=blk.label)
-            if cache is not None and cache.alive:
+            if cache is not None and cache.staging:
                 # keep the staged buffers for HBM replay; the counts tail
                 # (epoch-0 feature-count push) is zeroed on device so a
                 # replayed step never re-counts
@@ -1197,6 +1234,17 @@ class SGDLearner(Learner):
                 pred, objv, auc = self._packed_eval(
                     self.store.state, i32, f32, b_cap, nnz_cap, u_cap,
                     binary)
+            if cache is not None and cache.staging:
+                # dictionary-store staging (second pass: the dictionary
+                # is complete and the capacity frozen — the OOB slot
+                # padding packed above stays truthful, enforced by the
+                # capacity guard)
+                wc = want_counts if is_train else False
+                cache.add(part,
+                          ("coo", i32, f32, b_cap, nnz_cap, u_cap, wc,
+                           binary, False, blk.size),
+                          i32.nbytes + f32.nbytes,
+                          capacity=self.store.state.capacity)
         else:
             slots = self.store.pad_slots(slots_np, u_cap)
             dev = pad_batch(cblk, num_uniq=n_uniq,
@@ -1214,9 +1262,10 @@ class SGDLearner(Learner):
             else:
                 pred, objv, auc = self._eval_step(self.store.state, dev,
                                                   slots)
-            if cache is not None and cache.alive:
+            if cache is not None and cache.staging:
                 cache.add(part, ("devbatch", dev, slots, blk.size),
-                          self._payload_nbytes((dev, slots)))
+                          self._payload_nbytes((dev, slots)),
+                          capacity=self.store.state.capacity)
         if job_type == K_PREDICTION and p.pred_out:
             # stream predictions per batch (SavePred,
             # sgd_learner.cc:231-238) — don't buffer the dataset

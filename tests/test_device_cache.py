@@ -71,18 +71,58 @@ def test_neg_sampling_disables_cache(rcv1_path):
     assert learner._get_cache(K_TRAINING) is None
 
 
-def test_dictionary_store_never_caches(rcv1_path):
-    """The dictionary store can grow its capacity, which would pull cached
-    out-of-bounds slot padding back in bounds — it must never cache."""
+def run_dict(rcv1_path, epochs=6, extra_callback=None, **over):
+    """Dictionary-store (no hash_capacity) run."""
     args = [("data_in", rcv1_path), ("data_format", "libsvm"),
             ("loss", "logit"), ("lr", "1"), ("l1", "1"), ("l2", "1"),
             ("batch_size", "25"), ("shuffle", "0"),
-            ("max_num_epochs", "2"), ("num_jobs_per_epoch", "1"),
+            ("max_num_epochs", str(epochs)), ("num_jobs_per_epoch", "1"),
             ("report_interval", "0"), ("stop_rel_objv", "0")]
+    args += [(k, str(v)) for k, v in over.items()]
     learner = Learner.create("sgd")
     learner.init(args)
-    assert learner._get_cache(K_TRAINING) is None
+    seen = []
+    learner.add_epoch_end_callback(lambda e, t, v: seen.append(t.loss))
+    if extra_callback is not None:
+        learner.add_epoch_end_callback(
+            lambda e, t, v: extra_callback(learner, e))
     learner.run()
+    return np.array(seen), learner
+
+
+def test_dictionary_store_caches_after_second_pass(rcv1_path):
+    """The dictionary store stages on its SECOND pass (pass one completes
+    the dictionary and freezes capacity); replayed epochs 2+ reproduce
+    the streamed trajectory exactly."""
+    ref, _ = run_dict(rcv1_path, device_cache_mb=0)
+    got, learner = run_dict(rcv1_path, device_cache_mb=256)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+    cache = learner._dev_caches[K_TRAINING]
+    assert cache.ready and cache.stage_after_pass == 1
+    assert cache.capacity == learner.store.state.capacity
+    assert sum(len(v) for v in cache.entries.values()) == 4  # 100/25
+
+
+def test_dictionary_cache_invalidates_on_capacity_growth(rcv1_path):
+    """A capacity change after staging (impossible for fixed data,
+    guarded anyway) must invalidate the cache — the staged OOB slot
+    padding would fall back in bounds — and training falls back to
+    streaming with the trajectory unchanged."""
+    ref, _ = run_dict(rcv1_path, device_cache_mb=0, epochs=5)
+
+    def grow_after_epoch(learner, e):
+        if e == 3:
+            # simulate post-staging growth
+            from difacto_tpu.updaters.sgd_updater import grow_state
+            learner.store.state = grow_state(
+                learner.store.param, learner.store.state,
+                learner.store.state.capacity * 2)
+
+    seen, learner = run_dict(rcv1_path, device_cache_mb=256, epochs=5,
+                             extra_callback=grow_after_epoch)
+    cache = learner._dev_caches[K_TRAINING]
+    assert not cache.alive  # invalidated by the capacity guard
+    np.testing.assert_allclose(seen, ref, rtol=1e-6, atol=1e-6)
 
 
 def test_shuffle_replay_permutes_batches(rcv1_path):
