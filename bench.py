@@ -51,8 +51,8 @@ def build_step(V_dim: int, capacity: int, v_dtype: str):
         state = state._replace(v_live=jnp.ones(capacity, dtype=bool))
 
     _, train_step, _ = make_step_fns(fns, loss)
-    # raw (unjitted) step: bench runs it inside its own jitted lax.scan;
-    # callers wanting a standalone step should jit it themselves
+    # raw (unjitted) step: the bench jits it with a donated state and
+    # dispatches per step, the production replay pattern
     return train_step, state
 
 
@@ -78,7 +78,11 @@ def make_batches(n: int, B: int, nnz_per_row: int, uniq_space: int,
         raw.append((uniq, inverse))
         u_cap = max(u_cap, bucket(len(uniq)))
 
+    import jax
     import jax.numpy as jnp
+
+    from difacto_tpu.ops.batch import panel_chunk_tokens
+    chunker = jax.jit(panel_chunk_tokens, static_argnums=(1,))
 
     out = []
     for uniq, inverse in raw:
@@ -91,14 +95,10 @@ def make_batches(n: int, B: int, nnz_per_row: int, uniq_space: int,
         )
         batch = pad_panel(blk, num_uniq=len(uniq), batch_cap=B,
                           width=nnz_per_row)
-        # presorted token order: the bench models the steady-state cached
-        # replay, which stages the sorted order once (panel_sort_tokens)
-        # and takes the sorted FM backward every step
-        flat = inverse.astype(np.int32)
-        order = np.argsort(flat, kind="stable").astype(np.int32)
-        batch = batch._replace(
-            sorted_rows=jnp.asarray(order // nnz_per_row),
-            sorted_lane=jnp.asarray(flat[order]))
+        # chunked-run backward layout: the bench models the steady-state
+        # cached replay, which stages the layout once (panel_chunk_tokens)
+        # and takes the chunked FM backward every step
+        batch = chunker(batch, u_cap)
         slots = np.sort(rng.permutation(capacity - 1)[:len(uniq)] + 1)
         out.append((batch, pad_slots_oob(slots.astype(np.int32), u_cap,
                                          capacity)))
@@ -110,13 +110,15 @@ def roofline(nnz: int, u_cap: int, V_dim: int, v_bytes: int,
     """Approximate HBM bytes moved per step vs measured stream bandwidth.
 
     Models the production step as benched: storage-dtype forward token
-    gather + the SORTED backward (docs/perf_notes.md) whose contribution
-    stream is always f32 [nnz, V_dim+1] (write + sorted-scatter read),
-    plus the sorted order/lane index reads."""
+    gather + the CHUNKED backward (docs/perf_notes.md) whose f32
+    [~nnz, V_dim+1] contribution stream moves once through the chunk
+    gather and once through the partial reduction, plus the chunk-layout
+    index reads."""
     table = u_cap * (2 * V_dim * v_bytes * 2 + 3 * 4 * 2)  # VVg g+s, scalars
     tokens = (nnz * (V_dim + 1) * v_bytes      # fwd [w|V] token gather
-              + nnz * (V_dim + 1) * 4 * 2      # bwd f32 contribs w+r
-              + nnz * 4 * 2)                   # sorted rows/lane indices
+              + nnz * (V_dim + 1) * 4 * 2      # bwd f32 contribs (chunk
+                                               # gather + partial reduce)
+              + nnz * 4 * 2)                   # chunk_idx/lane reads (~)
     total = table + tokens
     return {
         "approx_bytes_per_step": int(total),
@@ -207,7 +209,7 @@ def main() -> None:
                     help="feature frequency skew (criteo is heavy-tailed)")
     ap.add_argument("--vdtype", choices=("float32", "bfloat16"),
                     default="bfloat16")
-    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--steps", type=int, default=40)
     mode = ap.add_mutually_exclusive_group()
     mode.add_argument("--e2e", action="store_true",
                       help="full text->train pipeline ONLY (skip device "
@@ -229,35 +231,28 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    step, state = build_step(args.vdim, args.capacity, args.vdtype)
+    step_raw, state = build_step(args.vdim, args.capacity, args.vdtype)
     host_batches = make_batches(4, args.batch_size, args.nnz_per_row,
                                 args.uniq, args.capacity, args.dist)
 
-    # stack the batches on device and run ALL steps inside one lax.scan:
-    # a single dispatch + a value fetch, so the measurement is pure device
-    # execution (per-step host dispatch RTT would otherwise dominate, and
-    # block_until_ready is unreliable through the device tunnel).
-    # stacked/slots ride as EXPLICIT jit arguments — closed-over device
-    # arrays become executable constants and re-upload through the slow
-    # tunnel on every compile (docs/perf_notes.md pitfall #2)
-    stacked = jax.tree_util.tree_map(
-        lambda *xs: jnp.stack(xs), *[b for b, _ in host_batches])
-    slots = jnp.stack([jnp.asarray(s) for _, s in host_batches])
+    # per-step dispatch with a DONATED state — the production replay
+    # pattern (learners/sgd.py replays cached batches one jitted call per
+    # step). A lax.scan harness measures the same body ~6% slower: XLA
+    # inserts carry copies for the gather-then-scatter table inside a
+    # while loop, a cost the product never pays (docs/perf_notes.md,
+    # "scan replay — negative result"). JAX async dispatch pipelines the
+    # per-call RTT, so the chained wall time is pure device execution;
+    # the final value fetch is the completion fence (block_until_ready is
+    # unreliable through the device tunnel, pitfall #1).
+    step = jax.jit(step_raw, donate_argnums=0)
+    batches = [jax.device_put(b) for b, _ in host_batches]
+    slots_l = [jnp.asarray(s) for _, s in host_batches]
     n_bk = len(host_batches)
-    u_cap = slots.shape[1]
-
-    @jax.jit
-    def run_steps(state, stacked, slots):
-        def scan_body(state, i):
-            batch = jax.tree_util.tree_map(lambda x: x[i % n_bk], stacked)
-            state, objv, auc = step(state, batch, slots[i % n_bk])
-            return state, objv
-        return jax.lax.scan(scan_body, state,
-                            jnp.arange(args.steps, dtype=jnp.int32))
+    u_cap = slots_l[0].shape[0]
 
     # warmup / compile (fetch forces completion)
-    state, objvs = run_steps(state, stacked, slots)
-    float(objvs[-1])
+    state, objv, _ = step(state, batches[0], slots_l[0])
+    float(objv)
 
     import contextlib
 
@@ -266,8 +261,9 @@ def main() -> None:
              else contextlib.nullcontext())
     with trace:
         t0 = time.perf_counter()
-        state, objvs = run_steps(state, stacked, slots)
-        float(objvs[-1])
+        for i in range(args.steps):
+            state, objv, _ = step(state, batches[i % n_bk], slots_l[i % n_bk])
+        float(objv)
         dt = time.perf_counter() - t0
 
     eps = args.steps * args.batch_size / dt

@@ -363,42 +363,42 @@ class SGDLearner(Learner):
         self._packed_panel_eval = jax.jit(packed_panel_eval,
                                           static_argnums=(3, 4, 5, 6, 7))
 
-        # sorted-token variant for cached replays: the backward's unsorted
-        # [B*F, k+2] scatter becomes a sorted segment reduction (1.43x at
-        # bench shapes, docs/perf_notes.md). The token order is computed on
-        # device ONCE at staging time (_panel_sort_packed) and replayed
-        # with the cached buffers — streaming epoch 0 keeps the unsorted
-        # step, so this adds exactly one extra compile per run.
-        def panel_sort_packed(i32, f32, b_cap, width, binary):
-            # the sorted arrays are staged PRECOMPUTED (sr+sl+sv): deriving
-            # them from the argsort order inside every replayed step was
-            # measured ~14 ms/step slower (it breaks XLA's fusion around
-            # the sorted scatter). Footprint: ~3x the packed i32 per
+        # chunked-run variant for cached replays: the backward's per-token
+        # scatter becomes a dense chunk gather+reduce plus a ~U + B*F/L row
+        # scatter (1.35x over the sorted path, 2.0x over unsorted at bench
+        # shapes, docs/perf_notes.md). The layout is computed on device
+        # ONCE at staging time (_panel_chunk_packed) and replayed with the
+        # cached buffers — streaming epoch 0 keeps the unsorted step, so
+        # this adds exactly one extra compile per run.
+        def panel_chunk_packed(i32, f32, b_cap, width, u_cap, binary):
+            # the chunk arrays are staged PRECOMPUTED (ci+cl+cv): like the
+            # earlier sorted order, deriving them inside every replayed
+            # step would break XLA's fusion around the reduction and pay
+            # the argsort per step. Footprint: ~2x the packed i32 per
             # cached train batch; a budget overflow degrades gracefully
             # to streaming (cache.add kills the cache), so tight
             # device_cache_mb budgets lose the replay, not correctness.
+            from ..ops.batch import panel_chunk_tokens_flat
             cells = b_cap * width
             flat = i32[:cells]
-            order = jnp.argsort(flat)
-            sr = (order // width).astype(jnp.int32)
-            sl = flat[order]
-            return (sr, sl, None if binary else f32[:cells][order])
+            vals = None if binary else f32[:cells]
+            return panel_chunk_tokens_flat(flat, vals, u_cap, b_cap, width)
 
-        self._panel_sort_packed = jax.jit(panel_sort_packed,
-                                          static_argnums=(2, 3, 4))
+        self._panel_chunk_packed = jax.jit(panel_chunk_packed,
+                                           static_argnums=(2, 3, 4, 5))
 
-        def packed_panel_train_sorted(state, i32, f32, sr, sl, sv, b_cap,
-                                      width, u_cap, has_cnt, binary,
-                                      has_remap=False):
+        def packed_panel_train_chunked(state, i32, f32, ci, cl, cv, b_cap,
+                                       width, u_cap, has_cnt, binary,
+                                       has_remap=False):
             pb, slots, counts = unpack_panel(i32, f32, b_cap, width, u_cap,
                                              has_cnt, binary, has_remap)
             if counts is not None:
                 state = fns.apply_count(state, slots, counts)
-            pb = pb._replace(sorted_rows=sr, sorted_lane=sl, sorted_vals=sv)
+            pb = pb._replace(chunk_idx=ci, chunk_lane=cl, chunk_vals=cv)
             return train_step(state, pb, slots)
 
-        self._packed_panel_train_sorted = jax.jit(
-            packed_panel_train_sorted, donate_argnums=0,
+        self._packed_panel_train_chunked = jax.jit(
+            packed_panel_train_chunked, donate_argnums=0,
             static_argnums=(6, 7, 8, 9, 10, 11))
         # device-side zeroing of the packed f32 counts tail: replayed cache
         # entries must not re-push epoch-0 feature counts
@@ -1112,13 +1112,13 @@ class SGDLearner(Learner):
                                                slots)
             pending.append((nrows, objv, auc))
             return
-        if payload[0] == "panel_sorted":
+        if payload[0] == "panel_chunked":
             # cached replay fast path (train only): packed panel + the
-            # staged sorted-token order
-            (_, i32, f32, sr, sl, sv, b_cap, d2, u_cap, want_counts,
+            # staged chunked-run backward layout
+            (_, i32, f32, ci, cl, cv, b_cap, d2, u_cap, want_counts,
              binary, has_rm, nrows) = payload
-            self.store.state, objv, auc = self._packed_panel_train_sorted(
-                self.store.state, i32, f32, sr, sl, sv, b_cap, d2, u_cap,
+            self.store.state, objv, auc = self._packed_panel_train_chunked(
+                self.store.state, i32, f32, ci, cl, cv, b_cap, d2, u_cap,
                 want_counts, binary, has_rm)
             pending.append((nrows, objv, auc))
             return
@@ -1166,14 +1166,14 @@ class SGDLearner(Learner):
             staging = (cache is not None and cache.staging
                        and layout == "panel" and is_train)
             if staging:
-                # cache-eligible panel training: sort ONCE at staging time
-                # and dispatch epoch 0 through the SAME sorted step the
-                # replays use — one compiled train variant per run, and
-                # every epoch takes the sorted backward
-                # (docs/perf_notes.md)
-                sr, sl, sv = self._panel_sort_packed(i32, f32, b_cap, d2,
-                                                     binary)
-                dev_payload = ("panel_sorted", i32, f32, sr, sl, sv, b_cap,
+                # cache-eligible panel training: build the chunked-run
+                # layout ONCE at staging time and dispatch epoch 0 through
+                # the SAME chunked step the replays use — one compiled
+                # train variant per run, and every epoch takes the chunked
+                # backward (docs/perf_notes.md)
+                ci, cl, cv = self._panel_chunk_packed(i32, f32, b_cap, d2,
+                                                      u_cap, binary)
+                dev_payload = ("panel_chunked", i32, f32, ci, cl, cv, b_cap,
                                d2, u_cap, wc, binary, has_rm, blk.size)
             else:
                 dev_payload = (layout, i32, f32, b_cap, d2, u_cap, wc,
@@ -1188,10 +1188,10 @@ class SGDLearner(Learner):
                     f32 = self._zero_counts(f32, u_cap)
                 nbytes = i32.nbytes + f32.nbytes
                 if staging:
-                    nbytes += sr.nbytes + sl.nbytes + (
-                        0 if sv is None else sv.nbytes)
+                    nbytes += ci.nbytes + cl.nbytes + (
+                        0 if cv is None else cv.nbytes)
                     cache.add(part,
-                              ("panel_sorted", i32, f32, sr, sl, sv, b_cap,
+                              ("panel_chunked", i32, f32, ci, cl, cv, b_cap,
                                d2, u_cap, wc, binary, has_rm, blk.size),
                               nbytes)
                 else:

@@ -132,45 +132,49 @@ def fm_predict_panel(params: FMParams, pb) -> jnp.ndarray:
     return fm_predict_panel_xv(params, pb)[0]
 
 
-def _fm_grad_panel_sorted(params: FMParams, pb, p: jnp.ndarray,
-                          XV: Optional[jnp.ndarray]
-                          ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
-    """Sorted-token backward (pb.sorted_* present, ops/batch.py
-    panel_sort_tokens): contributions are computed directly IN
-    lane-sorted order — a gather from the small [B, k+1] row-quantity
-    array — and merged with a sorted segment reduction. Measured 1.43x
-    over the unsorted scatter at bench shapes (B=65536, F=39, k=64): the
-    scatter's random read-modify-write of [U, k+2] rows becomes one
-    ascending pass. f32 contributions measured FASTER than bf16 here (the
-    cast inside the sorted scatter costs more than the bandwidth saves).
+def _fm_grad_panel_chunked(params: FMParams, pb, p: jnp.ndarray,
+                           XV: Optional[jnp.ndarray]
+                           ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Chunked-run backward (pb.chunk_* present, ops/batch.py
+    panel_chunk_tokens): the fastest variant. The sorted scatter-add is a
+    serial per-token update loop (~10 ns/row — half the fused step at
+    bench shapes, the round-4 trace's fusion.9); here the per-lane sums
+    are computed as a dense vectorised gather+reduce over fixed-L chunks
+    of each lane's token run, and the scatter shrinks to ~U + B*F/L
+    partial rows. Measured 53.3 -> 39.4 ms full-step (1.35x faster than
+    the sorted path it replaced) at bench shapes (docs/perf_notes.md).
 
-    For binary panels gw == xxp (x == x^2), so the reduction carries k+1
-    columns; with values the k+2nd column weights by v^2."""
+    Padded chunk cells gather row b_cap (out of bounds -> 0); padded
+    chunks carry lane u_cap (out of bounds -> dropped)."""
     U = params.w.shape[0]
     if params.V is None or params.V.shape[1] == 0:
-        contrib = p[pb.sorted_rows]
-        if pb.sorted_vals is not None:
-            contrib = contrib * pb.sorted_vals
-        gw = jnp.zeros((U,), jnp.float32).at[pb.sorted_lane].add(
-            contrib, indices_are_sorted=True)
+        toks = p.at[pb.chunk_idx].get(mode="fill", fill_value=0)  # [C, L]
+        if pb.chunk_vals is not None:
+            toks = toks * pb.chunk_vals
+        gw = jnp.zeros((U,), jnp.float32).at[pb.chunk_lane].add(
+            jnp.sum(toks, axis=1), indices_are_sorted=True, mode="drop")
         return gw, None
     k = params.V.shape[1]
     vm = _vmask(params)
     Vm = (params.V * vm.astype(params.V.dtype)[:, None]).astype(jnp.float32)
-    pXV = p[:, None] * XV                            # [B, k]
-    if pb.sorted_vals is None:
-        row_q = jnp.concatenate([pXV, p[:, None]], axis=1)   # [B, k+1]
-        red = jnp.zeros((U, k + 1), jnp.float32).at[pb.sorted_lane].add(
-            row_q[pb.sorted_rows], indices_are_sorted=True)
+    row_q = jnp.concatenate([p[:, None] * XV, p[:, None]], axis=1)  # [B,k+1]
+    toks = row_q.at[pb.chunk_idx].get(mode="fill",
+                                      fill_value=0)       # [C, L, k+1]
+    if pb.chunk_vals is None:
+        # binary panel: gw == xxp (x == x^2), k+1 columns serve both
+        partial = jnp.sum(toks, axis=1)                    # [C, k+1]
+        red = jnp.zeros((U, k + 1), jnp.float32).at[pb.chunk_lane].add(
+            partial, indices_are_sorted=True, mode="drop")
         t1, gw = red[:, :k], red[:, k]
         xxp = gw
     else:
-        row_q = jnp.concatenate([pXV, p[:, None], p[:, None]], axis=1)
-        v = pb.sorted_vals[:, None]
-        scale = jnp.concatenate(
-            [jnp.broadcast_to(v, (v.shape[0], k + 1)), v * v], axis=1)
-        red = jnp.zeros((U, k + 2), jnp.float32).at[pb.sorted_lane].add(
-            row_q[pb.sorted_rows] * scale, indices_are_sorted=True)
+        v = pb.chunk_vals[:, :, None]                      # [C, L, 1]
+        partial = jnp.concatenate([
+            jnp.sum(toks * v, axis=1),                     # t1 | gw (x v)
+            jnp.sum(toks[:, :, k:] * (v * v), axis=1),     # xxp   (x v^2)
+        ], axis=1)                                         # [C, k+2]
+        red = jnp.zeros((U, k + 2), jnp.float32).at[pb.chunk_lane].add(
+            partial, indices_are_sorted=True, mode="drop")
         t1, gw, xxp = red[:, :k], red[:, k], red[:, k + 1]
     gV = (t1 - xxp[:, None] * Vm) * vm[:, None]
     return gw, gV
@@ -184,15 +188,15 @@ def fm_grad_panel(params: FMParams, pb, pred: jnp.ndarray,
     [B*F, k+2] -> [U, k+2] for (t1 | gw | xxp). Same math as fm_grad
     (fm_loss.h:124-126,148-203). ``xv`` is the forward's X·V
     (fm_predict_panel_xv); None re-gathers the tokens to rebuild it.
-    Batches carrying a presorted token order (panel_sort_tokens) take the
-    sorted fast path instead."""
+    Batches carrying a chunked-run layout (panel_chunk_tokens) take the
+    chunked fast path."""
     U = params.w.shape[0]
     B, F = pb.idx.shape
     p = _p_vector(pred, pb)                          # [B]
-    if pb.sorted_lane is not None:
+    if pb.chunk_lane is not None:
         if params.V is not None and params.V.shape[1] > 0 and xv is None:
             _, xv = fm_predict_panel_xv(params, pb)
-        return _fm_grad_panel_sorted(params, pb, p, xv)
+        return _fm_grad_panel_chunked(params, pb, p, xv)
     flat_idx = pb.idx.reshape(B * F)
     if params.V is None or params.V.shape[1] == 0:
         cell = jnp.broadcast_to(p[:, None], (B, F))

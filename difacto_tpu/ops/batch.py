@@ -76,14 +76,15 @@ class PanelBatch(NamedTuple):
     num_rows: jnp.ndarray  # i32[]
     num_uniq: jnp.ndarray  # i32[]
     remap: Optional[jnp.ndarray] = None  # i32[u_cap]; see DeviceBatch.remap
-    # token order sorted by lane (panel_sort_tokens): when present the FM
-    # backward accumulates with a SORTED segment reduction instead of the
-    # unsorted [B*F, k+2] scatter — measured 1.43x faster at bench shapes
-    # (docs/perf_notes.md). Produced once per batch at device-cache staging
-    # time, so replayed (steady-state) epochs get it for free.
-    sorted_rows: Optional[jnp.ndarray] = None  # i32[B*F] token -> row
-    sorted_lane: Optional[jnp.ndarray] = None  # i32[B*F] ascending lanes
-    sorted_vals: Optional[jnp.ndarray] = None  # f32[B*F] (None if binary)
+    # chunked-run layout (panel_chunk_tokens): the fastest backward. Each
+    # lane's token run is padded into fixed-L gather chunks; the per-token
+    # sorted scatter (a serial ~10 ns/row update loop, half the fused step
+    # at bench shapes) becomes a dense vectorised gather+reduce to per-chunk
+    # partials plus a scatter of only ~U + B*F/L rows (docs/perf_notes.md,
+    # round-4 "chunked backward"). Staged once per batch like sorted_*.
+    chunk_idx: Optional[jnp.ndarray] = None   # i32[C, L] token row ids
+    chunk_lane: Optional[jnp.ndarray] = None  # i32[C] ascending lanes
+    chunk_vals: Optional[jnp.ndarray] = None  # f32[C, L] (None if binary)
 
     @property
     def batch_cap(self) -> int:
@@ -235,25 +236,78 @@ def unpack_panel(i32, f32, batch_cap: int, width: int, u_cap: int,
     return pb, slots, counts
 
 
-def panel_sort_tokens(pb: PanelBatch) -> PanelBatch:
-    """Attach the lane-sorted token order to a panel batch (jit-traceable;
-    run ONCE per batch — e.g. at device-cache staging — not per step).
+# Chunk length of the run-chunked backward layout. L=16 measured fastest at
+# bench shapes (L=8: more chunks to scatter; L=32/64: more gather padding
+# on the zipf run-length distribution — docs/perf_notes.md).
+CHUNK_L = 16
 
-    The FM backward's wall is an unsorted scatter-add of a [B*F, k+2]
-    contribution stream. With tokens pre-sorted by lane, contributions are
-    computed directly in sorted order by gathering from the SMALL [B, k+1]
-    row-quantity array and merged with a sorted segment reduction
-    (losses/fm.py). The failed round-4 attempt permutation-gathered the
-    precomputed contribution stream (a ~676 MB HBM operand); gathering the
-    row quantities instead is what makes sorting pay."""
+
+def chunk_cap(u_cap: int, cells: int, L: int = CHUNK_L) -> int:
+    """Static chunk-count bound: every one of the <= u_cap lane runs wastes
+    less than one chunk of padding, plus cells/L full chunks."""
+    return u_cap + cells // L + 2
+
+
+def panel_chunk_tokens_flat(flat_idx: jnp.ndarray,
+                            flat_vals: Optional[jnp.ndarray],
+                            u_cap: int, b_cap: int, width: int,
+                            L: int = CHUNK_L):
+    """Chunked-run backward layout from flat panel lanes (jit-traceable;
+    run ONCE per batch at device-cache staging time).
+
+    Tokens are lane-sorted; each lane's contiguous run is split into
+    ceil(len/L) chunks of exactly L gather slots (pad -> ``b_cap``, an
+    out-of-bounds row that gather-fills 0). Returns
+
+      chunk_idx  i32[C, L]  token row ids per chunk,
+      chunk_lane i32[C]     ascending output lane per chunk (pad -> u_cap,
+                            dropped by the reduction's mode="drop"),
+      chunk_vals f32[C, L]  per-token values (None when ``flat_vals`` is),
+
+    with C = chunk_cap(u_cap, cells, L) — a function of static shapes only,
+    so one jit signature serves every batch of a shape schedule. Used
+    chunks form a prefix and their lanes are ascending; runs split across
+    chunks simply scatter-add multiple partials into the same lane."""
+    cells = flat_idx.shape[0]
+    C = chunk_cap(u_cap, cells, L)
+    order = jnp.argsort(flat_idx)
+    lane = flat_idx[order].astype(jnp.int32)             # ascending
+    rows = (order // width).astype(jnp.int32)
+    ari = jnp.arange(cells, dtype=jnp.int32)
+    prev = jnp.concatenate([jnp.full((1,), -1, lane.dtype), lane[:-1]])
+    start = lane != prev                                  # run-start flags
+    rid = jnp.cumsum(start.astype(jnp.int32)) - 1         # [cells] run ids
+    RC = u_cap + 1                                        # lanes < u_cap
+    run_start = jnp.full((RC,), cells, jnp.int32).at[rid].min(
+        jnp.where(start, ari, cells), mode="drop")
+    run_len = jnp.zeros((RC,), jnp.int32).at[rid].add(1, mode="drop")
+    n_chunks = (run_len + L - 1) // L
+    chunk_base = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(n_chunks)[:-1]])
+    q = ari - run_start[rid]                              # pos within run
+    c = chunk_base[rid] + q // L                          # ascending
+    cell = c * L + q % L                                  # ascending unique
+    ci = jnp.full((C * L,), b_cap, jnp.int32).at[cell].set(
+        rows, indices_are_sorted=True, unique_indices=True, mode="drop")
+    cl = jnp.full((C,), u_cap, jnp.int32).at[c].set(
+        lane, indices_are_sorted=True, mode="drop")
+    cv = None
+    if flat_vals is not None:
+        cv = jnp.zeros((C * L,), flat_vals.dtype).at[cell].set(
+            flat_vals[order], indices_are_sorted=True, unique_indices=True,
+            mode="drop").reshape(C, L)
+    return ci.reshape(C, L), cl, cv
+
+
+def panel_chunk_tokens(pb: PanelBatch, u_cap: int,
+                       L: int = CHUNK_L) -> PanelBatch:
+    """Attach the chunked-run backward layout to a panel batch. ``u_cap``
+    is the batch's lane-space size (its slot vector length)."""
     B, F = pb.idx.shape
     flat = pb.idx.reshape(B * F)
-    order = jnp.argsort(flat)
-    sv = None if pb.vals is None else pb.vals.reshape(B * F)[order]
-    return pb._replace(
-        sorted_rows=(order // F).astype(jnp.int32),
-        sorted_lane=flat[order],
-        sorted_vals=sv)
+    fv = None if pb.vals is None else pb.vals.reshape(B * F)
+    ci, cl, cv = panel_chunk_tokens_flat(flat, fv, u_cap, B, F, L)
+    return pb._replace(chunk_idx=ci, chunk_lane=cl, chunk_vals=cv)
 
 
 def bucket(n: int, minimum: int = 8) -> int:

@@ -164,9 +164,9 @@ def test_cache_iter_parts_order_and_permutation():
     assert list(c.iter_parts(True, seed=3)) == shuf  # deterministic
 
 
-def test_panel_replay_sorted_backward(tmp_path):
+def test_panel_replay_chunked_backward(tmp_path):
     """Criteo-format (uniform-width panel) cached replay: epochs 1+ take
-    the sorted-token backward (panel_sort_tokens staged at cache time) and
+    the chunked-run backward (panel_chunk_tokens staged at cache time) and
     reproduce the streamed trajectory; only summation order differs."""
     rng = np.random.RandomState(5)
     path = tmp_path / "criteo.txt"
@@ -196,9 +196,9 @@ def test_panel_replay_sorted_backward(tmp_path):
     got, learner = run(256)
     cache = learner._dev_caches[K_TRAINING]
     assert cache.ready
-    # the cached payloads really carry the sorted order (panel path)
+    # the cached payloads really carry the chunked layout (panel path)
     payloads = [pl for items in cache.entries.values() for pl in items]
-    assert payloads and all(pl[0] == "panel_sorted" for pl in payloads)
+    assert payloads and all(pl[0] == "panel_chunked" for pl in payloads)
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
 
 

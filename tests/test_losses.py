@@ -221,18 +221,19 @@ def test_panel_matches_coo():
     check(blk_r, U, int(counts.max()))
 
 
-def test_sorted_backward_matches_unsorted():
-    """The sorted-token panel backward (panel_sort_tokens +
-    _fm_grad_panel_sorted) reproduces the unsorted scatter backward on
-    binary, valued/ragged, and V=None panels."""
+def test_chunked_backward_matches_unsorted():
+    """The chunked-run panel backward (panel_chunk_tokens +
+    _fm_grad_panel_chunked) reproduces the unsorted scatter backward on
+    binary, valued/ragged, and V=None panels, including zipf-skewed lanes
+    (runs longer than CHUNK_L split across chunks)."""
     import numpy as np
     import jax.numpy as jnp
     from difacto_tpu.data.rowblock import RowBlock
     from difacto_tpu.losses import FMParams, fm_grad_panel, fm_predict_panel
-    from difacto_tpu.ops.batch import pad_panel, panel_sort_tokens
+    from difacto_tpu.ops.batch import pad_panel, panel_chunk_tokens
 
-    rng = np.random.RandomState(11)
-    U, k, B = 96, 6, 24
+    rng = np.random.RandomState(12)
+    U, k, B = 96, 6, 48
 
     def check(blk, width, V_dim):
         w = jnp.asarray(rng.randn(U).astype(np.float32))
@@ -243,36 +244,68 @@ def test_sorted_backward_matches_unsorted():
         pb = pad_panel(blk, U, B, width)
         pred = fm_predict_panel(params, pb)
         gw_u, gV_u = fm_grad_panel(params, pb, pred)
-        pbs = panel_sort_tokens(pb)
-        assert pbs.sorted_lane is not None
-        gw_s, gV_s = fm_grad_panel(params, pbs, pred)
-        np.testing.assert_allclose(np.asarray(gw_u), np.asarray(gw_s),
+        pbc = panel_chunk_tokens(pb, U)
+        assert pbc.chunk_lane is not None
+        gw_c, gV_c = fm_grad_panel(params, pbc, pred)
+        np.testing.assert_allclose(np.asarray(gw_u), np.asarray(gw_c),
                                    rtol=2e-5, atol=1e-6)
         if V_dim:
-            np.testing.assert_allclose(np.asarray(gV_u), np.asarray(gV_s),
+            np.testing.assert_allclose(np.asarray(gV_u), np.asarray(gV_c),
                                        rtol=2e-5, atol=1e-6)
         else:
-            assert gV_u is None and gV_s is None
+            assert gV_u is None and gV_c is None
 
-    # uniform binary rows (the criteo shape)
-    F = 5
-    blk_u = RowBlock(
+    # uniform binary rows with zipf-skewed lanes: hot lanes get token runs
+    # far longer than CHUNK_L, exercising multi-chunk runs
+    F = 7
+    idx_z = ((rng.zipf(1.3, B * F) - 1) % U).astype(np.uint32)
+    blk_z = RowBlock(
         offset=np.arange(B + 1, dtype=np.int64) * F,
         label=rng.choice([0.0, 1.0], B).astype(np.float32),
-        index=rng.randint(0, U, B * F).astype(np.uint32),
+        index=idx_z,
         value=None)
-    check(blk_u, F, V_dim=k)
-    check(blk_u, F, V_dim=0)
+    check(blk_z, F, V_dim=k)
+    check(blk_z, F, V_dim=0)
 
     # ragged weighted rows, partial batch (pad rows + pad cells)
-    counts = rng.randint(1, 7, 17)
-    off = np.zeros(18, dtype=np.int64)
+    counts = rng.randint(1, 7, 29)
+    off = np.zeros(30, dtype=np.int64)
     np.cumsum(counts, out=off[1:])
     blk_r = RowBlock(
         offset=off,
-        label=rng.choice([0.0, 1.0], 17).astype(np.float32),
+        label=rng.choice([0.0, 1.0], 29).astype(np.float32),
         index=rng.randint(0, U, off[-1]).astype(np.uint32),
         value=rng.rand(off[-1]).astype(np.float32),
-        weight=rng.rand(17).astype(np.float32))
+        weight=rng.rand(29).astype(np.float32))
     check(blk_r, int(counts.max()), V_dim=k)
     check(blk_r, int(counts.max()), V_dim=0)
+
+
+def test_panel_chunk_layout_invariants():
+    """panel_chunk_tokens_flat: chunk lanes ascend, every token row id
+    appears exactly once among its lane's chunk cells, pads point out of
+    bounds, and the layout stays within the static chunk_cap bound."""
+    import numpy as np
+    import jax.numpy as jnp
+    from difacto_tpu.ops.batch import (CHUNK_L, chunk_cap,
+                                       panel_chunk_tokens_flat)
+
+    rng = np.random.RandomState(13)
+    B, F, u_cap = 64, 5, 40
+    flat = ((rng.zipf(1.3, B * F) - 1) % u_cap).astype(np.int32)
+    ci, cl, cv = panel_chunk_tokens_flat(jnp.asarray(flat), None, u_cap,
+                                         B, F)
+    ci, cl = np.asarray(ci), np.asarray(cl)
+    assert ci.shape == (chunk_cap(u_cap, B * F), CHUNK_L)
+    used = cl < u_cap
+    # used chunks form a prefix with ascending lanes
+    assert used[:used.sum()].all()
+    assert (np.diff(cl[used]) >= 0).all()
+    # padded chunks carry no real cells
+    assert (ci[~used] == B).all()
+    # per lane: the multiset of (row) tokens matches the panel
+    for lane in range(u_cap):
+        toks = ci[cl == lane]
+        toks = toks[toks < B]
+        want = np.flatnonzero(flat == lane) // F
+        np.testing.assert_array_equal(np.sort(toks), np.sort(want))
