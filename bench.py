@@ -216,7 +216,10 @@ def main() -> None:
                            "step)")
     mode.add_argument("--device-only", action="store_true",
                       help="device step only (skip the e2e pipeline run)")
-    ap.add_argument("--e2e-rows", type=int, default=600_000)
+    ap.add_argument("--e2e-rows", type=int, default=1_800_000,
+                    help="rows in the e2e window; large enough that the "
+                         "fixed epoch-boundary cost (final metric fetch, "
+                         "~2 RTT on a tunneled chip) amortizes")
     ap.add_argument("--e2e-batch", type=int, default=32768,
                     help="training batch size for the e2e pipeline run")
     ap.add_argument("--profile", metavar="DIR", default="",
