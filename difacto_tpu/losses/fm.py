@@ -32,6 +32,11 @@ from ..ops.segment import spmm, spmm_t, spmv, spmv_t
 
 PRED_CLAMP = 20.0
 
+# widest panel that takes the unrolled column-loop forward; wider panels
+# use the single [B,F]-cell gather (trace size is linear in width for
+# the loop, constant for the big gather)
+_COLLOOP_MAX_WIDTH = 64
+
 
 class FMParams(NamedTuple):
     """Gathered per-batch parameter rows."""
@@ -98,12 +103,20 @@ def fm_grad(params: FMParams, batch: DeviceBatch, pred: jnp.ndarray,
 
 def fm_predict_panel_xv(params: FMParams, pb
                         ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
-    """Panel-layout forward (ops/batch.py PanelBatch): ONE [B,F]-cell
-    gather of combined [w | V] rows, then dense reductions over the fixed
-    row width — no COO segment machinery. Same arithmetic as fm_predict
-    (fm_loss.h:43,67-119). Returns (pred, XV) so the backward can skip the
-    duplicate token gather (its only use of per-token V is recomputing
-    XV — ~330 MB/step at bench shapes)."""
+    """Panel-layout forward (ops/batch.py PanelBatch): one [B]-row gather
+    of combined [w | V] rows PER PANEL COLUMN, accumulated into f32
+    running sums — no COO segment machinery. Same arithmetic as
+    fm_predict (fm_loss.h:43,67-119). Returns (pred, XV) so the backward
+    can skip the duplicate token gather.
+
+    The column loop (vs one [B,F]-cell gather) keeps each per-column
+    token block VMEM-resident: the single big gather made XLA materialize
+    the [B*F, 1+k] token stream to HBM plus a layout reshape (~10 ms of a
+    39 ms step at bench shapes, traced); the unrolled loop measures
+    37.8 ms vs 39.4 (docs/perf_notes.md). Panels wider than
+    _COLLOOP_MAX_WIDTH fall back to the single-gather form — the loop
+    unrolls one gather per column into the jit trace, so program size
+    and compile time grow linearly with width."""
     if params.V is None or params.V.shape[1] == 0:
         wc = params.w[pb.idx]                       # [B, F]
         if pb.vals is not None:
@@ -113,17 +126,36 @@ def fm_predict_panel_xv(params: FMParams, pb
     # the per-token gather (the step's largest stream at big batches)
     # moves half the bytes; accumulation is f32 below
     dt = params.V.dtype
+    k = params.V.shape[1]
+    B, F = pb.idx.shape
     Vm = params.V * _vmask(params).astype(dt)[:, None]
     wv = jnp.concatenate([params.w.astype(dt)[:, None], Vm], axis=1)
-    tok = wv[pb.idx]                                 # [B, F, 1+k]
-    wc, t = tok[:, :, 0].astype(jnp.float32), tok[:, :, 1:]
-    if pb.vals is not None:
-        wc = wc * pb.vals
-        t = t * pb.vals[:, :, None].astype(dt)       # t = val * V
-    t = t.astype(jnp.float32)
-    pred = jnp.sum(wc, axis=1)
-    XV = jnp.sum(t, axis=1)
-    XXVV = jnp.sum(t * t, axis=1)
+    if F > _COLLOOP_MAX_WIDTH:
+        tok = wv[pb.idx]                             # [B, F, 1+k]
+        wc, t = tok[:, :, 0].astype(jnp.float32), tok[:, :, 1:]
+        if pb.vals is not None:
+            wc = wc * pb.vals
+            t = t * pb.vals[:, :, None].astype(dt)   # t = val * V
+        t = t.astype(jnp.float32)
+        pred = jnp.sum(wc, axis=1)
+        XV = jnp.sum(t, axis=1)
+        XXVV = jnp.sum(t * t, axis=1)
+    else:
+        idxT = pb.idx.T                              # [F, B]
+        pred = jnp.zeros((B,), jnp.float32)
+        XV = jnp.zeros((B, k), jnp.float32)
+        XXVV = jnp.zeros((B, k), jnp.float32)
+        for f in range(F):
+            tok = wv[idxT[f]]                        # [B, 1+k]
+            wc = tok[:, 0].astype(jnp.float32)
+            t = tok[:, 1:]
+            if pb.vals is not None:
+                wc = wc * pb.vals[:, f]
+                t = t * pb.vals[:, f, None].astype(dt)  # t = val * V
+            t = t.astype(jnp.float32)
+            pred = pred + wc
+            XV = XV + t
+            XXVV = XXVV + t * t
     pred = pred + 0.5 * jnp.sum(XV * XV - XXVV, axis=1)
     return jnp.clip(pred, -PRED_CLAMP, PRED_CLAMP), XV
 
