@@ -13,7 +13,8 @@ test:
 	$(PY) -m pytest tests/ -x -q
 
 smoke:
-	$(PY) bench.py --steps 2 --batch-size 128 --uniq 256 --capacity 1024 --vdim 4
+	$(PY) bench.py --device-only --steps 2 --batch-size 128 --uniq 256 --capacity 1024 --vdim 4
+	$(PY) bench.py --e2e --e2e-rows 2000 --e2e-batch 256 --capacity 4096 --vdim 4
 	$(PY) -c "import jax, __graft_entry__; \
 	fn, args = __graft_entry__.entry(); \
 	jax.block_until_ready(jax.jit(fn)(*args)); \
