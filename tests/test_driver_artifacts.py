@@ -22,14 +22,26 @@ def _run(cmd, extra_env=None):
 
 
 def test_bench_device_mode_smoke():
-    proc = _run([sys.executable, "bench.py", "--steps", "2",
-                 "--batch-size", "128", "--uniq", "256",
+    # --device-only: the default e2e window is 1.8M rows, far too slow
+    # for a CPU smoke (the e2e path gets its own tiny-window test below)
+    proc = _run([sys.executable, "bench.py", "--device-only",
+                 "--steps", "2", "--batch-size", "128", "--uniq", "256",
                  "--capacity", "1024", "--vdim", "4"])
     assert proc.returncode == 0, proc.stderr
     line = proc.stdout.strip().splitlines()[-1]
     rec = json.loads(line)
     assert rec["value"] > 0
     assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
+
+
+def test_bench_e2e_smoke():
+    proc = _run([sys.executable, "bench.py", "--e2e",
+                 "--e2e-rows", "2000", "--e2e-batch", "256",
+                 "--capacity", "4096", "--vdim", "4"])
+    assert proc.returncode == 0, proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["value"] > 0
+    assert rec["config"]["rows"] == 2000
 
 
 def test_graft_entry_single_chip():
