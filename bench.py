@@ -106,15 +106,19 @@ def make_batches(n: int, B: int, nnz_per_row: int, uniq_space: int,
 
 
 def roofline(nnz: int, u_cap: int, V_dim: int, v_bytes: int,
-             dt_sec: float) -> dict:
+             dt_sec: float, vvg_cols: int = 0) -> dict:
     """Approximate HBM bytes moved per step vs measured stream bandwidth.
 
     Models the production step as benched: storage-dtype forward token
     gather + the CHUNKED backward (docs/perf_notes.md) whose f32
     [~nnz, V_dim+1] contribution stream moves once through the chunk
     gather and once through the partial reduction, plus the chunk-layout
-    index reads."""
-    table = u_cap * (2 * V_dim * v_bytes * 2 + 3 * 4 * 2)  # VVg g+s, scalars
+    index reads. ``vvg_cols`` is the ACTUAL stored row width (pad_v_rows
+    lane-pads narrow V to the 128-lane tile; defaults to the compact
+    2*V_dim)."""
+    if not vvg_cols:
+        vvg_cols = 2 * V_dim
+    table = u_cap * (vvg_cols * v_bytes * 2 + 3 * 4 * 2)  # VVg g+s, scalars
     tokens = (nnz * (V_dim + 1) * v_bytes      # fwd [w|V] token gather
               + nnz * (V_dim + 1) * 4 * 2      # bwd f32 contribs (chunk
                                                # gather + partial reduce)
@@ -282,7 +286,8 @@ def main() -> None:
                    "dist": args.dist, "V_dtype": args.vdtype,
                    "uniq_rows_per_step": u_cap},
         "roofline": roofline(args.batch_size * args.nnz_per_row, u_cap,
-                             args.vdim, v_bytes, dt / args.steps),
+                             args.vdim, v_bytes, dt / args.steps,
+                             vvg_cols=int(state.VVg.shape[1])),
     }
     if not args.device_only:
         # the product number rides the default output so a pipeline

@@ -234,26 +234,30 @@ class SlotStore:
     def _sorted_items(self) -> Tuple[np.ndarray, np.ndarray]:
         return self._keys, self._slots
 
-    @staticmethod
-    def _state_np(state: SGDState) -> dict:
-        """Host view with the logical V/Vg split (state stores fused VVg).
-        Multi-host: the table is fs-sharded within each host (dp replicates
-        across hosts), so every piece is locally addressable."""
+    def _state_np(self, state: SGDState) -> dict:
+        """Host view with the logical V/Vg split (state stores fused VVg,
+        halves padded to v_half lanes; the split slices back to the
+        logical V_dim columns so checkpoints/dumps are pad-free and
+        layout-independent). Multi-host: the table is fs-sharded within
+        each host (dp replicates across hosts), so every piece is locally
+        addressable."""
         from ..parallel.multihost import to_local_numpy
         d = {f: to_local_numpy(a) for f, a in zip(SGDState._fields, state)}
         # bf16 storage (V_dtype) becomes float32 on the host: numpy/npz
         # have no bfloat16
         vv = d.pop("VVg").astype(np.float32)
-        k = vv.shape[1] // 2
-        d["V"], d["Vg"] = vv[:, :k], vv[:, k:]
+        k, h = self.param.V_dim, vv.shape[1] // 2
+        d["V"], d["Vg"] = vv[:, :k], vv[:, h:h + k]
         return d
 
     def _assemble_state(self, arr: dict) -> SGDState:
-        """Inverse of _state_np: dict with V/Vg -> SGDState with VVg."""
-        from ..updaters.sgd_updater import v_dtype
-        vvg = np.concatenate([arr.pop("V"), arr.pop("Vg")],
-                             axis=1).astype(np.float32)
-        return SGDState(VVg=jnp.asarray(vvg).astype(v_dtype(self.param)),
+        """Inverse of _state_np: dict with logical-width V/Vg -> SGDState
+        with the (possibly lane-padded) fused VVg."""
+        from ..updaters.sgd_updater import fuse_vvg, v_dtype, v_half
+        V = np.asarray(arr.pop("V"), dtype=np.float32)
+        Vg = np.asarray(arr.pop("Vg"), dtype=np.float32)
+        vvg = fuse_vvg(V, Vg, v_half(self.param, V.shape[0]))
+        return SGDState(VVg=vvg.astype(v_dtype(self.param)),
                         **{f: jnp.asarray(a) for f, a in arr.items()})
 
     def save(self, path: str, save_aux: bool = False) -> int:

@@ -70,22 +70,38 @@ class SGDUpdaterParam(Param):
     # always stay float32 — z accumulates and must not round.
     V_dtype: str = field(default="float32",
                          metadata=dict(enum=["float32", "bfloat16"]))
+    # pad each VVg half to a multiple of 64 elements so the fused row is a
+    # multiple of the 128-lane TPU tile width. Sub-lane-width rows make the
+    # per-row table scatter a misaligned read-modify-write: at V_dim=16
+    # over a 4.2M-row table, the [196k, 32] scatter measured 33 ms vs
+    # 15 ms for the padded [196k, 128] row — MORE bytes, half the time
+    # (docs/perf_notes.md). The pad costs up to 4x VVg HBM at V_dim<=32,
+    # so it auto-disables when the padded table would exceed
+    # ``pad_v_rows_max_mb`` (the donated-state double plus the batch
+    # cache must still fit; an 8.4M-row V16 bf16 table OOMed a 16 GB
+    # chip padded but trains unpadded). Set pad_v_rows=False to force
+    # the compact layout.
+    pad_v_rows: bool = True
+    pad_v_rows_max_mb: int = 1536
 
 
 class SGDState(NamedTuple):
     """Slot-table model state; all arrays have capacity+1 rows (row 0 trash).
 
     The embedding values and their AdaGrad accumulators live in ONE array
-    ``VVg`` (f32[C, 2k]: V in [:, :k], Vg in [:, k:]) so the per-step
-    gather/scatter touches a single wide row per feature — TPU scatter cost
-    scales with the number of scattered rows, so one 2k-wide scatter beats
-    two k-wide ones (measured ~22 ms vs ~44 ms for 131k rows, k=64).
+    ``VVg`` (f32[C, 2h]: V in [:, :k], Vg in [:, h:h+k], with h =
+    v_half(param) >= k) so the per-step gather/scatter touches a single
+    wide row per feature — TPU scatter cost scales with the number of
+    scattered rows, so one wide scatter beats two narrow ones (measured
+    ~22 ms vs ~44 ms for 131k rows, k=64). Each half is zero-padded from
+    k to h so the row is a multiple of the 128-lane tile width
+    (pad_v_rows; see SGDUpdaterParam).
     """
     w: jnp.ndarray        # f32[C]
     z: jnp.ndarray        # f32[C] FTRL dual
     sqrt_g: jnp.ndarray   # f32[C] FTRL accumulated grad norm
     cnt: jnp.ndarray      # f32[C] feature occurrence counts
-    VVg: jnp.ndarray      # f32[C, 2k] embeddings + AdaGrad accumulators
+    VVg: jnp.ndarray      # f32[C, 2h] embeddings + AdaGrad accumulators
     v_live: jnp.ndarray   # bool[C] embedding activated
 
     @property
@@ -105,8 +121,37 @@ def v_dtype(param: SGDUpdaterParam):
     return jnp.bfloat16 if param.V_dtype == "bfloat16" else jnp.float32
 
 
-def init_state(param: SGDUpdaterParam, capacity: int) -> SGDState:
+def v_half(param: SGDUpdaterParam, capacity: int) -> int:
+    """Stored width of each VVg half at this table capacity: V_dim
+    rounded up to a multiple of 64 (so the fused [V | Vg] row is a
+    multiple of the 128-lane tile) when pad_v_rows and the padded table
+    fits pad_v_rows_max_mb, else exactly V_dim. Kernels never call this —
+    they read the layout off ``VVg.shape[1] // 2``."""
     k = param.V_dim
+    if k == 0 or not param.pad_v_rows:
+        return k
+    h = -(-k // 64) * 64
+    bytes_per_el = 2 if param.V_dtype == "bfloat16" else 4
+    if capacity * 2 * h * bytes_per_el > param.pad_v_rows_max_mb << 20:
+        return k
+    return h
+
+
+def fuse_vvg(V, Vg, h: int):
+    """THE padded-row layout, in one place: [V | pad | Vg | pad] with each
+    half zero-padded from k columns to h. Accepts jnp or numpy halves;
+    every builder of a VVg array (init, growth re-layout, the update
+    write-back, checkpoint assembly) goes through here so the layout
+    cannot drift between sites."""
+    k = V.shape[1]
+    if h == k:
+        return jnp.concatenate([V, Vg], axis=1)
+    pad = jnp.zeros((V.shape[0], h - k), dtype=jnp.asarray(V).dtype)
+    return jnp.concatenate([V, pad, Vg, pad], axis=1)
+
+
+def init_state(param: SGDUpdaterParam, capacity: int) -> SGDState:
+    k, h = param.V_dim, v_half(param, capacity)
     key = jax.random.PRNGKey(param.seed)
     V = (jax.random.uniform(key, (capacity, k), dtype=jnp.float32) - 0.5) \
         * param.V_init_scale
@@ -115,20 +160,26 @@ def init_state(param: SGDUpdaterParam, capacity: int) -> SGDState:
         return jnp.zeros(capacity, dtype=jnp.float32)
     return SGDState(
         w=zeros(), z=zeros(), sqrt_g=zeros(), cnt=zeros(),
-        VVg=jnp.concatenate(
-            [V, jnp.zeros((capacity, k), dtype=jnp.float32)],
-            axis=1).astype(v_dtype(param)),
+        VVg=fuse_vvg(V, jnp.zeros((capacity, k), jnp.float32),
+                     h).astype(v_dtype(param)),
         v_live=jnp.zeros(capacity, dtype=bool),
     )
 
 
 def grow_state(param: SGDUpdaterParam, state: SGDState, new_capacity: int
                ) -> SGDState:
-    """Double-and-copy growth; new V rows get fresh init values."""
+    """Double-and-copy growth; new V rows get fresh init values. Growth
+    can cross the pad_v_rows_max_mb threshold, shrinking v_half back to
+    V_dim — old rows are re-laid-out to the new half width."""
     old = state.capacity
     if new_capacity <= old:
         return state
     ext = init_state(param, new_capacity)
+    if param.V_dim and ext.VVg.shape[1] != state.VVg.shape[1]:
+        k = param.V_dim
+        oh, nh = state.VVg.shape[1] // 2, ext.VVg.shape[1] // 2
+        state = state._replace(VVg=fuse_vvg(
+            state.VVg[:, :k], state.VVg[:, oh:oh + k], nh))
     return SGDState(*(jnp.concatenate([a, jnp.asarray(b)[old:]], axis=0)
                       for a, b in zip(state, ext)))
 
@@ -215,15 +266,17 @@ def make_fns(param: SGDUpdaterParam):
         )
 
         if has_V and gV is not None:
-            # ONE gather + ONE scatter over the fused [V | Vg] rows
+            # ONE gather + ONE scatter over the fused [V | pad | Vg | pad]
+            # rows; the half width rides the array shape (v_half)
+            h = state.VVg.shape[1] // 2
             VVg = _gather(state.VVg, slots).astype(jnp.float32)
-            V, Vg = VVg[:, :param.V_dim], VVg[:, param.V_dim:]
+            V = VVg[:, :param.V_dim]
+            Vg = VVg[:, h:h + param.V_dim]
             gv = gV + V_l2 * V
             Vg_new = jnp.sqrt(Vg * Vg + gv * gv)
             V_new = V - V_lr / (Vg_new + V_lr_beta) * gv
             upd = pull_vmask[:, None] > 0
-            new_rows = jnp.where(
-                upd, jnp.concatenate([V_new, Vg_new], axis=1), VVg)
+            new_rows = jnp.where(upd, fuse_vvg(V_new, Vg_new, h), VVg)
             state = state._replace(
                 VVg=_scatter(state.VVg, slots,
                              new_rows.astype(state.VVg.dtype)))

@@ -110,3 +110,59 @@ def test_sgd_prediction_task(rcv1_path, tmp_path):
     assert len(lines) == 100
     lab, prob = lines[0].split("\t")
     assert 0.0 <= float(prob) <= 1.0
+
+
+def test_padded_vvg_rows():
+    """pad_v_rows: the lane-padded [V | pad | Vg | pad] layout is bitwise
+    equivalent to the compact one, auto-disables over the memory budget,
+    and re-lays-out on growth across the threshold."""
+    import jax.numpy as jnp
+    from difacto_tpu.losses import FMParams
+    from difacto_tpu.updaters.sgd_updater import (SGDUpdaterParam,
+                                                  grow_state, init_state,
+                                                  make_fns, v_half)
+
+    # budget gate: small table pads, huge table falls back to compact
+    p = SGDUpdaterParam(V_dim=16, V_threshold=0, pad_v_rows_max_mb=1)
+    assert v_half(p, 1024) == 64
+    assert v_half(p, 1 << 20) == 16
+    assert v_half(SGDUpdaterParam(V_dim=16, pad_v_rows=False), 1024) == 16
+    assert v_half(SGDUpdaterParam(V_dim=64), 1024) == 64  # already aligned
+
+    rng = np.random.RandomState(3)
+    C, U, k = 256, 32, 16
+    slots = np.sort(rng.permutation(C - 1)[:U] + 1).astype(np.int32)
+    gw = rng.randn(U).astype(np.float32)
+    gV = rng.randn(U, k).astype(np.float32) * 0.1
+
+    def run(pad):
+        par = SGDUpdaterParam(V_dim=k, V_threshold=0, lr=0.1, l1=0.01,
+                              pad_v_rows=pad)
+        fns = make_fns(par)
+        st = init_state(par, C)._replace(v_live=jnp.ones(C, dtype=bool))
+        for _ in range(3):
+            st = fns.apply_grad(st, jnp.asarray(slots), jnp.asarray(gw),
+                                jnp.asarray(gV), jnp.ones(U))
+        w, V, vm = fns.get_rows(st, jnp.asarray(slots))
+        return np.asarray(w), np.asarray(V), np.asarray(fns.evaluate(st))
+
+    wp, Vp, ep = run(True)
+    wc, Vc, ec = run(False)
+    np.testing.assert_array_equal(wp, wc)
+    np.testing.assert_array_equal(Vp, Vc)
+    np.testing.assert_array_equal(ep, ec)
+
+    # growth across the budget threshold re-lays-out old rows
+    par = SGDUpdaterParam(V_dim=k, V_threshold=0, lr=0.1, l1=0.01,
+                          pad_v_rows_max_mb=1)
+    fns = make_fns(par)
+    st = init_state(par, 1024)._replace(v_live=jnp.ones(1024, dtype=bool))
+    assert st.VVg.shape[1] == 128
+    st = fns.apply_grad(st, jnp.asarray(slots), jnp.asarray(gw),
+                        jnp.asarray(gV), jnp.ones(U))
+    _, V_before, _ = fns.get_rows(st, jnp.asarray(slots))
+    grown = grow_state(par, st, 1 << 20)
+    assert grown.VVg.shape[1] == 2 * k  # compact after crossing the cap
+    _, V_after, _ = fns.get_rows(grown, jnp.asarray(slots))
+    np.testing.assert_array_equal(np.asarray(V_before),
+                                  np.asarray(V_after))
