@@ -1298,13 +1298,22 @@ class SGDLearner(Learner):
         pending.append((blk.size, objv, auc))
 
     def _save_pred(self, pred: np.ndarray, label) -> None:
-        """SavePred (sgd_learner.h:72-83); per-rank output file."""
+        """SavePred (sgd_learner.h:72-83); per-rank output file. The batch
+        is bulk-formatted into ONE write — a per-row f.write loop measured
+        Python-bound (~100k rows/s) on million-row pred tasks, while the
+        reference streams per batch in C++ (sgd_learner.h:72-83)."""
         if self._fo_pred is None:
             from ..utils import stream
             self._fo_pred = stream.open_stream(
                 f"{self.param.pred_out}_part-{self._host_rank}", "w")
         out = 1.0 / (1.0 + np.exp(-pred)) if self.param.pred_prob else pred
-        for i, v in enumerate(out):
-            if label is not None:
-                self._fo_pred.write(f"{label[i]:g}\t")
-            self._fo_pred.write(f"{v:g}\n")
+        n = len(out)
+        if n == 0:
+            return
+        if label is not None:
+            inter = np.empty(2 * n, dtype=np.float64)
+            inter[0::2] = np.asarray(label)[:n]
+            inter[1::2] = out
+            self._fo_pred.write(("%g\t%g\n" * n) % tuple(inter))
+        else:
+            self._fo_pred.write(("%g\n" * n) % tuple(out))

@@ -250,13 +250,23 @@ class SlotStore:
         d["V"], d["Vg"] = vv[:, :k], vv[:, h:h + k]
         return d
 
-    def _assemble_state(self, arr: dict) -> SGDState:
+    def _assemble_state(self, arr: dict, capacity: int) -> SGDState:
         """Inverse of _state_np: dict with logical-width V/Vg -> SGDState
-        with the (possibly lane-padded) fused VVg."""
+        with the (possibly lane-padded) fused VVg. ``capacity`` is the
+        LIVE table capacity the state is being assembled for — the
+        pad_v_rows layout decision must match the table that will train,
+        not the artifact's row count (a partial/sharded save with fewer
+        rows would otherwise silently re-enable padding on a table that
+        runs unpadded for memory reasons, round-4 advisor finding)."""
         from ..updaters.sgd_updater import fuse_vvg, v_dtype, v_half
         V = np.asarray(arr.pop("V"), dtype=np.float32)
         Vg = np.asarray(arr.pop("Vg"), dtype=np.float32)
-        vvg = fuse_vvg(V, Vg, v_half(self.param, V.shape[0]))
+        if V.shape[0] != capacity:
+            raise ValueError(
+                f"checkpoint arrays have {V.shape[0]} rows but the table "
+                f"capacity is {capacity}: partial-state loads are not "
+                "supported (the v_half layout decision would diverge)")
+        vvg = fuse_vvg(V, Vg, v_half(self.param, capacity))
         return SGDState(VVg=vvg.astype(v_dtype(self.param)),
                         **{f: jnp.asarray(a) for f, a in arr.items()})
 
@@ -317,7 +327,8 @@ class SlotStore:
                     if k in z.files:
                         arr[k] = z[k]
                 nnz = int((np.asarray(arr["w"]) != 0).sum())
-                self.state = self._place(self._assemble_state(arr))
+                self.state = self._place(self._assemble_state(
+                    arr, self.param.hash_capacity))
                 return nnz
             ck_vdim = int(z["V_dim"]) if "V_dim" in z.files else 0
             if ck_vdim != self.param.V_dim:
@@ -345,7 +356,7 @@ class SlotStore:
                 arr["sqrt_g"][sl] = z["sqrt_g"]
                 if z["Vg"].size:
                     arr["Vg"][sl] = z["Vg"]
-            self.state = self._place(self._assemble_state(arr))
+            self.state = self._place(self._assemble_state(arr, cap))
         return n
 
     def dump(self, path: str, dump_aux: bool = False,

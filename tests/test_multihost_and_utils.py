@@ -1,25 +1,10 @@
-"""Hashed store mode, VectorClock, and host-part plumbing tests."""
+"""Hashed store mode and host-part plumbing tests."""
 
 import numpy as np
 import pytest
 
 from difacto_tpu.learners import Learner
 from difacto_tpu.parallel.multihost import host_part
-from difacto_tpu.store.vector_clock import VectorClock
-
-
-def test_vector_clock():
-    vc = VectorClock(3)
-    assert not vc.update(0)       # min still 0
-    assert not vc.update(1)
-    assert vc.update(2)           # min advances 0 -> 1
-    assert vc.min() == 1 and vc.max() == 1
-    vc.update(0, 5)
-    assert vc.get(0) == 5
-    assert vc.may_proceed(1, max_delay=2)      # 1 - 1 <= 2
-    assert not vc.may_proceed(0, max_delay=2)  # 5 - 1 > 2
-    with pytest.raises(ValueError):
-        vc.update(0, 3)  # clocks are monotone
 
 
 def test_host_part_single_controller():
