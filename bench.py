@@ -35,7 +35,10 @@ REF_PSLITE_32W_EPS = 5.0e5
 MEASURED_HBM_GBPS = 87.0  # 1GiB stream mul+reduce, this chip via tunnel
 
 
-def build_step(V_dim: int, capacity: int, v_dtype: str):
+def build_step(V_dim: int, capacity: int, v_dtype: str,
+               chunks_sorted: bool = True):
+    import dataclasses
+
     from difacto_tpu.losses import create
     from difacto_tpu.step import make_step_fns
     from difacto_tpu.updaters.sgd_updater import (SGDUpdaterParam, init_state,
@@ -45,6 +48,8 @@ def build_step(V_dim: int, capacity: int, v_dtype: str):
                             l2=1e-4, V_dtype=v_dtype)
     fns = make_fns(param)
     loss = create("fm", V_dim)
+    if not chunks_sorted:
+        loss = dataclasses.replace(loss, chunks_sorted=False)
     state = init_state(param, capacity)
     if V_dim:
         import jax.numpy as jnp
@@ -57,10 +62,13 @@ def build_step(V_dim: int, capacity: int, v_dtype: str):
 
 
 def make_batches(n: int, B: int, nnz_per_row: int, uniq_space: int,
-                 capacity: int, dist: str, seed: int = 0):
+                 capacity: int, dist: str, seed: int = 0,
+                 chunk_multiple: int = 1):
     """Host-side localized PANEL batches (fixed-width [B, F] index matrix,
     the criteo layout) + sorted-unique slot vectors padded with ascending
-    out-of-bounds indices (the device-kernel contract)."""
+    out-of-bounds indices (the device-kernel contract).
+    ``chunk_multiple`` > 1 pads the chunk arrays' C axis up to a multiple
+    (mesh runs shard C over the dp axis, which needs even division)."""
     from difacto_tpu.data.rowblock import RowBlock
     from difacto_tpu.ops.batch import bucket, pad_panel
     from difacto_tpu.store.local import pad_slots_oob
@@ -98,7 +106,21 @@ def make_batches(n: int, B: int, nnz_per_row: int, uniq_space: int,
         # chunked-run backward layout: the bench models the steady-state
         # cached replay, which stages the layout once (panel_chunk_tokens)
         # and takes the chunked FM backward every step
-        batch = chunker(batch, u_cap)
+        if chunk_multiple > 1:
+            # mesh runs shard the C axis over dp: build host-side with C
+            # rounded up (the same path learners/sgd.py _panel_host_batch
+            # takes), instead of the device chunker
+            from difacto_tpu.ops.batch import (chunk_cap,
+                                               panel_chunk_tokens_np)
+            C = -(-chunk_cap(u_cap, B * nnz_per_row) // chunk_multiple) \
+                * chunk_multiple
+            ci, cl, cv = panel_chunk_tokens_np(
+                inverse.astype(np.int32), None, u_cap, B, nnz_per_row, C=C)
+            batch = batch._replace(chunk_idx=jnp.asarray(ci),
+                                   chunk_lane=jnp.asarray(cl),
+                                   chunk_vals=cv)
+        else:
+            batch = chunker(batch, u_cap)
         slots = np.sort(rng.permutation(capacity - 1)[:len(uniq)] + 1)
         out.append((batch, pad_slots_oob(slots.astype(np.int32), u_cap,
                                          capacity)))
@@ -229,6 +251,12 @@ def main() -> None:
     ap.add_argument("--profile", metavar="DIR", default="",
                     help="capture a device trace of the timed step window "
                          "into DIR (view with xprof/TensorBoard)")
+    ap.add_argument("--mesh", metavar="DPxFS", default="",
+                    help="run the SAME panel/chunked step as a sharded "
+                         "program over a (dp, fs) jax.sharding.Mesh "
+                         "(e.g. 1x1 on one chip proves the sharded "
+                         "lowering keeps the flat-path rate; 2x4 on the "
+                         "virtual CPU mesh checks multi-device)")
     args = ap.parse_args()
 
     if args.e2e:
@@ -238,9 +266,19 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    step_raw, state = build_step(args.vdim, args.capacity, args.vdtype)
+    mesh = None
+    if args.mesh:
+        from difacto_tpu.parallel import make_mesh
+        dp, fs = (int(v) for v in args.mesh.lower().split("x"))
+        mesh = make_mesh(dp=dp, fs=fs)
+
+    step_raw, state = build_step(args.vdim, args.capacity, args.vdtype,
+                                 chunks_sorted=mesh is None
+                                 or mesh.shape["dp"] == 1)
     host_batches = make_batches(4, args.batch_size, args.nnz_per_row,
-                                args.uniq, args.capacity, args.dist)
+                                args.uniq, args.capacity, args.dist,
+                                chunk_multiple=(mesh.shape["dp"]
+                                                if mesh else 1))
 
     # per-step dispatch with a DONATED state — the production replay
     # pattern (learners/sgd.py replays cached batches one jitted call per
@@ -252,8 +290,17 @@ def main() -> None:
     # the final value fetch is the completion fence (block_until_ready is
     # unreliable through the device tunnel, pitfall #1).
     step = jax.jit(step_raw, donate_argnums=0)
-    batches = [jax.device_put(b) for b, _ in host_batches]
-    slots_l = [jnp.asarray(s) for _, s in host_batches]
+    if mesh is not None:
+        from difacto_tpu.parallel import (batch_sharding, replicated,
+                                          shard_pytree, state_sharding)
+        state = shard_pytree(state, state_sharding(mesh))
+        batches = [shard_pytree(b, batch_sharding(mesh))
+                   for b, _ in host_batches]
+        slots_l = [jax.device_put(np.asarray(s), replicated(mesh))
+                   for _, s in host_batches]
+    else:
+        batches = [jax.device_put(b) for b, _ in host_batches]
+        slots_l = [jnp.asarray(s) for _, s in host_batches]
     n_bk = len(host_batches)
     u_cap = slots_l[0].shape[0]
 
@@ -276,7 +323,8 @@ def main() -> None:
     eps = args.steps * args.batch_size / dt
     v_bytes = 2 if args.vdtype == "bfloat16" else 4
     out = {
-        "metric": "fm_v64_train_examples_per_sec",
+        "metric": ("fm_v64_train_examples_per_sec" if mesh is None else
+                   f"fm_v64_mesh{args.mesh}_train_examples_per_sec"),
         "value": round(eps, 1),
         "unit": "examples/sec",
         "vs_baseline": round(eps / REF_PSLITE_32W_EPS, 3),
@@ -289,7 +337,7 @@ def main() -> None:
                              args.vdim, v_bytes, dt / args.steps,
                              vvg_cols=int(state.VVg.shape[1])),
     }
-    if not args.device_only:
+    if not args.device_only and mesh is None:
         # the product number rides the default output so a pipeline
         # regression is driver-visible (round-3 verdict #10)
         out["e2e"] = run_e2e(args)

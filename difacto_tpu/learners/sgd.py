@@ -258,6 +258,12 @@ class SGDLearner(Learner):
             from ..parallel import make_mesh
             self.mesh = make_mesh(dp=self.param.mesh_dp,
                                   fs=self.param.mesh_fs)
+            if self.param.mesh_dp > 1:
+                # dp-sharded chunk_lane blocks are sorted per shard but
+                # not globally — the chunked backward must not promise
+                # sorted indices to XLA (losses/__init__.py chunks_sorted)
+                self.loss = dataclasses.replace(self.loss,
+                                                chunks_sorted=False)
         self.store = SlotStore(uparam, mesh=self.mesh)
         self.do_embedding = self.V_dim > 0
         if self.param.train_auc not in ("binned", "exact", "none"):
@@ -680,9 +686,11 @@ class SGDLearner(Learner):
         pending: list = []
         while True:
             item = next(it, None)
-            # [slots(u) | counts(u) if push_cnt | nrows | has] — the counts
-            # half is only shipped on the epoch-0 count push
-            payload = np.zeros((2 * u_cap if push_cnt else u_cap) + 2,
+            # [slots(u) | counts(u) if push_cnt | fmax | nrows | has] — the
+            # counts half is only shipped on the epoch-0 count push; fmax
+            # (this host's max row nnz) lets every host agree on the
+            # panel-vs-COO layout for the step
+            payload = np.zeros((2 * u_cap if push_cnt else u_cap) + 3,
                                dtype=np.int64)
             cblk = slots_np = None
             if item is not None:
@@ -703,6 +711,8 @@ class SGDLearner(Learner):
                 payload[:nu] = slots_np
                 if push_cnt and cnts is not None:
                     payload[u_cap:u_cap + nu] = cnts.astype(np.int64)
+                counts_r = np.diff(cblk.offset)
+                payload[-3] = int(counts_r.max()) if len(counts_r) else 0
                 payload[-2] = blk.size
                 payload[-1] = 1
             # DCN control-plane exchange, guarded by the dead-host monitor:
@@ -712,7 +722,7 @@ class SGDLearner(Learner):
             if self.monitor is not None:
                 g = self.monitor.guarded(allgather_np, payload)
             else:
-                g = allgather_np(payload)      # [n_hosts, 2u+2]
+                g = allgather_np(payload)  # [n_hosts, (2u|u)+3]
             if g[:, -1].max() == 0:
                 break
             union = np.unique(g[:, :u_cap])
@@ -734,42 +744,84 @@ class SGDLearner(Learner):
                     put_global(cts.astype(np.float32),
                                replicated(self.mesh)))
 
-            # local block at the pinned caps (zeros = inert padding)
-            rows = np.zeros(nnz_cap, dtype=np.int32)
-            cols = np.zeros(nnz_cap, dtype=np.int32)
-            vals = np.zeros(nnz_cap, dtype=np.float32)
-            labels = np.zeros(b_cap, dtype=np.float32)
-            rweight = np.zeros(b_cap, dtype=np.float32)
-            row_mask = np.zeros(b_cap, dtype=np.float32)
-            if cblk is not None:
-                b, nnz = cblk.size, cblk.nnz
-                # row ids address the GLOBAL label space: this host's rows
-                # live at [rank*b_cap, rank*b_cap + b) of the concatenated
-                # dp batch
-                base = self._host_rank * b_cap
-                rows[:nnz] = cblk.row_ids() + base
-                rows[nnz:] = base + max(b - 1, 0)
-                pos_local = np.searchsorted(union, slots_np).astype(np.int32)
-                cols[:nnz] = pos_local[cblk.index]
-                vals[:nnz] = cblk.values_or_ones()
-                labels[:b] = cblk.label
-                rweight[:b] = (cblk.weight if cblk.weight is not None
-                               else 1.0)
-                row_mask[:b] = 1.0
-
-            from ..ops.batch import DeviceBatch
             nrows_g = int(g[:, -2].sum())
-            batch = DeviceBatch(
-                rows=put_dp_local(rows, self.mesh),
-                cols=put_dp_local(cols, self.mesh),
-                vals=put_dp_local(vals, self.mesh),
-                labels=put_dp_local(labels, self.mesh),
-                rweight=put_dp_local(rweight, self.mesh),
-                row_mask=put_dp_local(row_mask, self.mesh),
-                num_rows=put_global(np.int32(nrows_g),
-                                    replicated(self.mesh)),
-                num_uniq=put_global(np.int32(gu), replicated(self.mesh)),
-            )
+            fmax_g = int(g[:, -3].max())
+            # global panel decision (every host computes it from the same
+            # allgathered metadata, so the jitted program agrees): the
+            # fixed-width panel + chunked-run backward is the fast step
+            # (docs/perf_notes.md); COO remains for heavily skewed rows
+            # and for eval/pred (whose Reader windows are ragged)
+            use_panel = (job_type == K_TRAINING and fmax_g > 0
+                         and b_cap * fmax_g <= 1.5 * nnz_cap)
+            if use_panel:
+                width_cap = self._shapes.cap("spmd.w", fmax_g, exact=True)
+                cblk2 = None
+                if cblk is not None:
+                    pos_local = np.searchsorted(union, slots_np)
+                    cblk2 = dataclasses.replace(
+                        cblk,
+                        index=pos_local[cblk.index].astype(np.uint32))
+                pb = self._panel_host_batch(
+                    cblk2, gu, b_cap, width_cap, gu_cap,
+                    dp_div=max(1, p.mesh_dp // self._num_hosts),
+                    row_base=self._host_rank * b_cap,
+                    b_fill=b_cap * self._num_hosts,
+                    force_vals=True)
+                from ..ops.batch import PanelBatch
+                batch = PanelBatch(
+                    idx=put_dp_local(pb.idx, self.mesh),
+                    vals=put_dp_local(pb.vals, self.mesh),
+                    labels=put_dp_local(pb.labels, self.mesh),
+                    rweight=put_dp_local(pb.rweight, self.mesh),
+                    row_mask=put_dp_local(pb.row_mask, self.mesh),
+                    num_rows=put_global(np.int32(nrows_g),
+                                        replicated(self.mesh)),
+                    num_uniq=put_global(np.int32(gu),
+                                        replicated(self.mesh)),
+                    chunk_idx=put_dp_local(pb.chunk_idx, self.mesh),
+                    chunk_lane=put_dp_local(pb.chunk_lane, self.mesh),
+                    chunk_vals=put_dp_local(pb.chunk_vals, self.mesh),
+                )
+                self._spmd_panel_steps = getattr(
+                    self, "_spmd_panel_steps", 0) + 1
+            else:
+                # local block at the pinned caps (zeros = inert padding)
+                rows = np.zeros(nnz_cap, dtype=np.int32)
+                cols = np.zeros(nnz_cap, dtype=np.int32)
+                vals = np.zeros(nnz_cap, dtype=np.float32)
+                labels = np.zeros(b_cap, dtype=np.float32)
+                rweight = np.zeros(b_cap, dtype=np.float32)
+                row_mask = np.zeros(b_cap, dtype=np.float32)
+                if cblk is not None:
+                    b, nnz = cblk.size, cblk.nnz
+                    # row ids address the GLOBAL label space: this host's
+                    # rows live at [rank*b_cap, rank*b_cap + b) of the
+                    # concatenated dp batch
+                    base = self._host_rank * b_cap
+                    rows[:nnz] = cblk.row_ids() + base
+                    rows[nnz:] = base + max(b - 1, 0)
+                    pos_local = np.searchsorted(union,
+                                                slots_np).astype(np.int32)
+                    cols[:nnz] = pos_local[cblk.index]
+                    vals[:nnz] = cblk.values_or_ones()
+                    labels[:b] = cblk.label
+                    rweight[:b] = (cblk.weight if cblk.weight is not None
+                                   else 1.0)
+                    row_mask[:b] = 1.0
+
+                from ..ops.batch import DeviceBatch
+                batch = DeviceBatch(
+                    rows=put_dp_local(rows, self.mesh),
+                    cols=put_dp_local(cols, self.mesh),
+                    vals=put_dp_local(vals, self.mesh),
+                    labels=put_dp_local(labels, self.mesh),
+                    rweight=put_dp_local(rweight, self.mesh),
+                    row_mask=put_dp_local(row_mask, self.mesh),
+                    num_rows=put_global(np.int32(nrows_g),
+                                        replicated(self.mesh)),
+                    num_uniq=put_global(np.int32(gu),
+                                        replicated(self.mesh)),
+                )
             if job_type == K_TRAINING:
                 self.store.state, objv, auc = self._train_step(
                     self.store.state, batch, slots_dev)
@@ -1272,8 +1324,23 @@ class SGDLearner(Learner):
                           capacity=self.store.state.capacity)
         else:
             slots = self.store.pad_slots(slots_np, u_cap)
-            dev = pad_batch(cblk, num_uniq=n_uniq,
-                            batch_cap=b_cap, nnz_cap=nnz_cap)
+            from ..ops.batch import panel_width
+            width = panel_width(cblk, b_cap)
+            if width is not None:
+                # mesh panel path: the SAME panel forward + chunked-run
+                # backward as the single-host packed path, dp-sharded
+                # (round-4 verdict #1 — the mesh step used to dispatch
+                # the unsorted COO backward, ~2x slower at bench shapes)
+                width = self._shapes.cap(job + ".w", width, exact=True)
+                dev = self._panel_host_batch(
+                    cblk, n_uniq, b_cap, width, u_cap,
+                    dp_div=self.param.mesh_dp,
+                    with_chunks=is_train)
+                self._mesh_panel_steps = getattr(
+                    self, "_mesh_panel_steps", 0) + 1
+            else:
+                dev = pad_batch(cblk, num_uniq=n_uniq,
+                                batch_cap=b_cap, nnz_cap=nnz_cap)
             from ..parallel import batch_sharding, shard_pytree
             dev = shard_pytree(dev, batch_sharding(self.mesh))
             if push_cnt:
@@ -1296,6 +1363,62 @@ class SGDLearner(Learner):
             # sgd_learner.cc:231-238) — don't buffer the dataset
             self._save_pred(np.asarray(pred)[:blk.size], blk.label)
         pending.append((blk.size, objv, auc))
+
+    def _panel_host_batch(self, cblk, n_uniq: int, b_cap: int, width: int,
+                          u_cap: int, dp_div: int, row_base: int = 0,
+                          b_fill: Optional[int] = None,
+                          num_rows: Optional[int] = None,
+                          force_vals: bool = False,
+                          with_chunks: bool = True):
+        """Host-side (numpy) PanelBatch for the mesh paths — the SAME
+        panel + chunked-run layout the single-host packed path stages on
+        device (round-4 verdict #1: the mesh step must not fall back to
+        the unsorted COO backward). ``cblk`` may be None (an out-of-data
+        SPMD host ships an all-pad batch so the synchronized schedule
+        holds); chunk row ids address the GLOBAL dp row space via
+        ``row_base``/``b_fill``; the chunk count rounds up to a multiple
+        of ``dp_div`` so the [C, L] arrays shard evenly over dp."""
+        from ..ops.batch import (PanelBatch, _panel_arrays, chunk_cap,
+                                 panel_chunk_tokens_np)
+        if b_fill is None:
+            b_fill = b_cap
+        C = -(-chunk_cap(u_cap, b_cap * width) // dp_div) * dp_div
+        if cblk is not None:
+            idx, vals, labels, rweight, row_mask = _panel_arrays(
+                cblk, b_cap, width)
+            if vals is None and force_vals:
+                # uniform full-batch binary block: every cell is a real
+                # token of value 1. The SPMD schedule materializes values
+                # so the jit signature (vals present) is identical across
+                # hosts and steps regardless of local raggedness.
+                vals = np.ones((b_cap, width), dtype=np.float32)
+        else:
+            idx = np.zeros((b_cap, width), dtype=np.int32)
+            vals = np.zeros((b_cap, width), dtype=np.float32) \
+                if force_vals else None
+            labels = np.zeros(b_cap, dtype=np.float32)
+            rweight = np.zeros(b_cap, dtype=np.float32)
+            row_mask = np.zeros(b_cap, dtype=np.float32)
+        ci = cl = cv = None
+        if with_chunks:
+            if cblk is not None:
+                fv = None if vals is None else vals.reshape(-1)
+                ci, cl, cv = panel_chunk_tokens_np(
+                    idx.reshape(-1), fv, u_cap, b_fill, width,
+                    C=C, row_base=row_base)
+            else:
+                from ..ops.batch import CHUNK_L
+                ci = np.full((C, CHUNK_L), b_fill, dtype=np.int32)
+                cl = np.full(C, u_cap, dtype=np.int32)
+                cv = (np.zeros((C, CHUNK_L), dtype=np.float32)
+                      if force_vals else None)
+        return PanelBatch(
+            idx=idx, vals=vals, labels=labels, rweight=rweight,
+            row_mask=row_mask,
+            num_rows=np.int32(num_rows if num_rows is not None
+                              else (cblk.size if cblk is not None else 0)),
+            num_uniq=np.int32(n_uniq),
+            chunk_idx=ci, chunk_lane=cl, chunk_vals=cv)
 
     def _save_pred(self, pred: np.ndarray, label) -> None:
         """SavePred (sgd_learner.h:72-83); per-rank output file. The batch

@@ -19,6 +19,12 @@ from . import metrics
 class LossSpec:
     name: str
     V_dim: int
+    # whether panel chunk_lane arrays are globally ascending — True for
+    # host-local/single-dp-shard layouts; the learner flips it False for
+    # dp>1 meshes, where each shard's block is sorted but the global
+    # concatenation is not (promising sorted indices to XLA's scatter
+    # would be undefined behavior; see fm._fm_grad_panel_chunked)
+    chunks_sorted: bool = True
 
     def predict(self, params: FMParams, batch):
         from ..ops.batch import PanelBatch
@@ -37,7 +43,8 @@ class LossSpec:
     def calc_grad(self, params: FMParams, batch, pred, xv=None):
         from ..ops.batch import PanelBatch
         if isinstance(batch, PanelBatch):
-            return fm_grad_panel(params, batch, pred, xv)
+            return fm_grad_panel(params, batch, pred, xv,
+                                 self.chunks_sorted)
         return fm_grad(params, batch, pred, xv)
 
     def evaluate(self, pred, batch):

@@ -165,7 +165,8 @@ def fm_predict_panel(params: FMParams, pb) -> jnp.ndarray:
 
 
 def _fm_grad_panel_chunked(params: FMParams, pb, p: jnp.ndarray,
-                           XV: Optional[jnp.ndarray]
+                           XV: Optional[jnp.ndarray],
+                           sorted_chunks: bool = True
                            ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Chunked-run backward (pb.chunk_* present, ops/batch.py
     panel_chunk_tokens): the fastest variant. The sorted scatter-add is a
@@ -177,14 +178,20 @@ def _fm_grad_panel_chunked(params: FMParams, pb, p: jnp.ndarray,
     the sorted path it replaced) at bench shapes (docs/perf_notes.md).
 
     Padded chunk cells gather row b_cap (out of bounds -> 0); padded
-    chunks carry lane u_cap (out of bounds -> dropped)."""
+    chunks carry lane u_cap (out of bounds -> dropped).
+
+    ``sorted_chunks`` declares chunk_lane globally ascending — true for
+    host-local/single-shard layouts, FALSE for dp-sharded mesh batches
+    (each shard's block is sorted but the concatenation is not; lying to
+    XLA's scatter lowering would be undefined behavior)."""
     U = params.w.shape[0]
     if params.V is None or params.V.shape[1] == 0:
         toks = p.at[pb.chunk_idx].get(mode="fill", fill_value=0)  # [C, L]
         if pb.chunk_vals is not None:
             toks = toks * pb.chunk_vals
         gw = jnp.zeros((U,), jnp.float32).at[pb.chunk_lane].add(
-            jnp.sum(toks, axis=1), indices_are_sorted=True, mode="drop")
+            jnp.sum(toks, axis=1), indices_are_sorted=sorted_chunks,
+            mode="drop")
         return gw, None
     k = params.V.shape[1]
     vm = _vmask(params)
@@ -196,7 +203,7 @@ def _fm_grad_panel_chunked(params: FMParams, pb, p: jnp.ndarray,
         # binary panel: gw == xxp (x == x^2), k+1 columns serve both
         partial = jnp.sum(toks, axis=1)                    # [C, k+1]
         red = jnp.zeros((U, k + 1), jnp.float32).at[pb.chunk_lane].add(
-            partial, indices_are_sorted=True, mode="drop")
+            partial, indices_are_sorted=sorted_chunks, mode="drop")
         t1, gw = red[:, :k], red[:, k]
         xxp = gw
     else:
@@ -206,14 +213,15 @@ def _fm_grad_panel_chunked(params: FMParams, pb, p: jnp.ndarray,
             jnp.sum(toks[:, :, k:] * (v * v), axis=1),     # xxp   (x v^2)
         ], axis=1)                                         # [C, k+2]
         red = jnp.zeros((U, k + 2), jnp.float32).at[pb.chunk_lane].add(
-            partial, indices_are_sorted=True, mode="drop")
+            partial, indices_are_sorted=sorted_chunks, mode="drop")
         t1, gw, xxp = red[:, :k], red[:, k], red[:, k + 1]
     gV = (t1 - xxp[:, None] * Vm) * vm[:, None]
     return gw, gV
 
 
 def fm_grad_panel(params: FMParams, pb, pred: jnp.ndarray,
-                  xv: Optional[jnp.ndarray] = None
+                  xv: Optional[jnp.ndarray] = None,
+                  sorted_chunks: bool = True
                   ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Panel-layout backward: per-cell contributions are pure BROADCASTS
     of row quantities (p, p*XV), merged by ONE combined segment reduction
@@ -228,7 +236,7 @@ def fm_grad_panel(params: FMParams, pb, pred: jnp.ndarray,
     if pb.chunk_lane is not None:
         if params.V is not None and params.V.shape[1] > 0 and xv is None:
             _, xv = fm_predict_panel_xv(params, pb)
-        return _fm_grad_panel_chunked(params, pb, p, xv)
+        return _fm_grad_panel_chunked(params, pb, p, xv, sorted_chunks)
     flat_idx = pb.idx.reshape(B * F)
     if params.V is None or params.V.shape[1] == 0:
         cell = jnp.broadcast_to(p[:, None], (B, F))

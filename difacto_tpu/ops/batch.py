@@ -299,6 +299,59 @@ def panel_chunk_tokens_flat(flat_idx: jnp.ndarray,
     return ci.reshape(C, L), cl, cv
 
 
+def panel_chunk_tokens_np(flat_idx: np.ndarray,
+                          flat_vals: Optional[np.ndarray],
+                          u_cap: int, b_fill: int, width: int,
+                          L: int = CHUNK_L, C: Optional[int] = None,
+                          row_base: int = 0):
+    """Host-side (numpy) twin of :func:`panel_chunk_tokens_flat`, for the
+    mesh/SPMD paths where the chunk layout is built per host at batch-prep
+    time rather than on device at cache-staging time:
+
+    - ``row_base`` offsets token row ids into the GLOBAL dp-concatenated
+      row space (this host's rows live at [row_base, row_base + b_local));
+    - ``b_fill`` is the out-of-bounds pad row (the GLOBAL batch cap for
+      sharded batches), so pad cells gather 0 under mode="fill";
+    - ``C`` pins the chunk count explicitly — mesh callers round it up to
+      a multiple of the dp axis so the [C, L] arrays shard evenly and
+      every host ships identical shapes.
+
+    Tokens are lane-sorted per host, so each host's chunk_lane block is
+    ascending — but the dp-concatenation of blocks is NOT globally
+    sorted, which is why the mesh step drops the ``indices_are_sorted``
+    promise (losses/fm.py ``chunks_sorted``)."""
+    cells = len(flat_idx)
+    if C is None:
+        C = chunk_cap(u_cap, cells, L)
+    order = np.argsort(flat_idx, kind="stable")
+    lane = flat_idx[order].astype(np.int32)
+    rows = (order // width).astype(np.int32) + row_base
+    start = np.empty(cells, dtype=bool)
+    if cells:
+        start[0] = True
+        start[1:] = lane[1:] != lane[:-1]
+    rid = np.cumsum(start) - 1                       # run ids per token
+    run_start = np.nonzero(start)[0]                 # first token of run
+    q = np.arange(cells, dtype=np.int64) - run_start[rid]  # pos in run
+    run_len = np.diff(np.append(run_start, cells))
+    n_chunks = (run_len + L - 1) // L
+    chunk_base = np.concatenate([[0], np.cumsum(n_chunks)[:-1]])
+    c = chunk_base[rid] + q // L
+    cell = c * L + q % L
+    if len(c) and c[-1] >= C:
+        raise ValueError(f"chunk count {c[-1] + 1} exceeds cap {C}")
+    ci = np.full(C * L, b_fill, dtype=np.int32)
+    ci[cell] = rows
+    cl = np.full(C, u_cap, dtype=np.int32)
+    cl[c] = lane
+    cv = None
+    if flat_vals is not None:
+        cv = np.zeros(C * L, dtype=flat_vals.dtype)
+        cv[cell] = flat_vals[order]
+        cv = cv.reshape(C, L)
+    return ci.reshape(C, L), cl, cv
+
+
 def panel_chunk_tokens(pb: PanelBatch, u_cap: int,
                        L: int = CHUNK_L) -> PanelBatch:
     """Attach the chunked-run backward layout to a panel batch. ``u_cap``

@@ -29,3 +29,19 @@ def rcv1_path() -> str:
     """First 100 rows of the public rcv1.binary dataset (libsvm format) —
     the same fixture the reference's golden tests use (tests/README.md)."""
     return str(pathlib.Path(__file__).parent / "data" / "rcv1_100.libsvm")
+
+
+def write_uniform_libsvm(path, rows: int = 200, width: int = 8,
+                         id_space: int = 300, seed: int = 7) -> str:
+    """Uniform-width libsvm data: every row has exactly ``width`` valued
+    features, so the panel layout (ops/batch.py panel_width) engages and
+    mesh/SPMD tests exercise the panel + chunked-run step instead of COO."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    with open(path, "w") as f:
+        for _ in range(rows):
+            ids = np.sort(rng.choice(id_space, width, replace=False))
+            vals = rng.rand(width)
+            f.write(str(rng.randint(0, 2)) + " " + " ".join(
+                f"{j}:{v:.4f}" for j, v in zip(ids, vals)) + "\n")
+    return str(path)

@@ -47,5 +47,6 @@ ln.run()
 
 rank = jax.process_index()
 with open(os.path.join(out_dir, f"traj-{rank}.json"), "w") as f:
-    json.dump({"train": seen, "val": seen_val}, f)
+    json.dump({"train": seen, "val": seen_val,
+               "panel_steps": getattr(ln, "_spmd_panel_steps", 0)}, f)
 print(f"rank {rank} done: {seen}")

@@ -321,3 +321,73 @@ def test_panel_chunk_layout_invariants():
         toks = toks[toks < B]
         want = np.flatnonzero(flat == lane) // F
         np.testing.assert_array_equal(np.sort(toks), np.sort(want))
+
+
+def test_numpy_chunker_and_unsorted_chunks_match():
+    """panel_chunk_tokens_np (the host-side twin the mesh paths use)
+    produces the same reduction as the jit chunker, including explicit-C
+    rounding and row_base offsets; and the chunked backward with
+    sorted_chunks=False (the dp>1 mesh setting) equals sorted_chunks=True."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from difacto_tpu.data.rowblock import RowBlock
+    from difacto_tpu.losses import FMParams, fm_grad_panel, fm_predict_panel
+    from difacto_tpu.ops.batch import (chunk_cap, pad_panel,
+                                       panel_chunk_tokens,
+                                       panel_chunk_tokens_np)
+
+    rng = np.random.RandomState(5)
+    B, F, u_cap = 48, 6, 40
+    flat = ((rng.zipf(1.3, B * F) - 1) % u_cap).astype(np.int32)
+    vals = rng.rand(B * F).astype(np.float32)
+
+    from difacto_tpu.ops.batch import panel_chunk_tokens_flat
+    ci_j, cl_j, cv_j = jax.jit(
+        panel_chunk_tokens_flat, static_argnums=(2, 3, 4))(
+            jnp.asarray(flat), jnp.asarray(vals), u_cap, B, F)
+    ci_n, cl_n, cv_n = panel_chunk_tokens_np(flat, vals, u_cap, B, F)
+
+    def reduce(ci, cl, cv, row_q, nrows):
+        ci, cl, cv = np.asarray(ci), np.asarray(cl), np.asarray(cv)
+        toks = np.where(ci[:, :, None] < nrows,
+                        row_q[np.minimum(ci, nrows - 1)], 0.0)
+        part = (toks * cv[:, :, None]).sum(axis=1)
+        out = np.zeros((u_cap, row_q.shape[1]))
+        m = cl < u_cap
+        np.add.at(out, cl[m], part[m])
+        return out
+
+    row_q = rng.rand(B, 4)
+    np.testing.assert_allclose(reduce(ci_j, cl_j, cv_j, row_q, B),
+                               reduce(ci_n, cl_n, cv_n, row_q, B),
+                               rtol=1e-5)
+
+    # explicit C (mesh dp rounding) + row_base (global dp row space)
+    C = -(-chunk_cap(u_cap, B * F) // 3) * 3
+    ci2, cl2, cv2 = panel_chunk_tokens_np(flat, vals, u_cap, 2 * B, F,
+                                          C=C, row_base=B)
+    assert ci2.shape[0] == C
+    rq2 = np.concatenate([np.zeros_like(row_q), row_q])
+    np.testing.assert_allclose(reduce(ci2, cl2, cv2, rq2, 2 * B),
+                               reduce(ci_j, cl_j, cv_j, row_q, B),
+                               rtol=1e-5)
+
+    # sorted_chunks=False backward (dp>1 meshes) == sorted backward
+    k = 5
+    blk = RowBlock(offset=np.arange(B + 1, dtype=np.int64) * F,
+                   label=rng.choice([0.0, 1.0], B).astype(np.float32),
+                   index=flat.astype(np.uint32),
+                   value=vals)
+    w = jnp.asarray(rng.randn(u_cap).astype(np.float32))
+    V = jnp.asarray(rng.randn(u_cap, k).astype(np.float32) * 0.1)
+    vm = jnp.asarray((rng.rand(u_cap) > 0.3).astype(np.float32))
+    params = FMParams(w=w, V=V, v_mask=vm)
+    pb = panel_chunk_tokens(pad_panel(blk, u_cap, B, F), u_cap)
+    pred = fm_predict_panel(params, pb)
+    gw_s, gV_s = fm_grad_panel(params, pb, pred, sorted_chunks=True)
+    gw_u, gV_u = fm_grad_panel(params, pb, pred, sorted_chunks=False)
+    np.testing.assert_allclose(np.asarray(gw_s), np.asarray(gw_u),
+                               rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gV_s), np.asarray(gV_u),
+                               rtol=2e-5, atol=1e-6)

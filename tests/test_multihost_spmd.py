@@ -81,3 +81,45 @@ def test_two_process_mesh_matches_single_host(rcv1_path, tmp_path):
     # per-rank checkpoints were written by both hosts
     assert (tmp_path / "model_part-0").exists()
     assert (tmp_path / "model_part-1").exists()
+
+
+def test_two_process_mesh_panel_path(tmp_path):
+    """Uniform-width data engages the SPMD panel + chunked-run step
+    (round-5: the synchronized schedule previously always built COO
+    batches and took the unsorted backward). Both ranks must agree on
+    the global panel decision, observe the identical trajectory, and
+    match a single-host run over the same data."""
+    from conftest import write_uniform_libsvm
+    data = write_uniform_libsvm(tmp_path / "uniform.libsvm", rows=100)
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = str(REPO)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "launch.py"), "-n", "2",
+         "--port", "7925", "--",
+         sys.executable, str(REPO / "tests" / "spmd_worker.py"),
+         str(tmp_path), data, "3"],
+        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\n" \
+                                 f"stderr:\n{proc.stderr}"
+    trajs = []
+    for rank in range(2):
+        with open(tmp_path / f"traj-{rank}.json") as f:
+            trajs.append(json.load(f))
+    assert trajs[0]["panel_steps"] > 0 and trajs[1]["panel_steps"] > 0
+    np.testing.assert_allclose(trajs[0]["train"], trajs[1]["train"],
+                               rtol=0, atol=0)
+
+    from difacto_tpu.learners import Learner
+    ln = Learner.create("sgd")
+    ln.init([("data_in", data), ("V_dim", "2"), ("V_threshold", "2"),
+             ("lr", "0.1"), ("l1", "0.1"), ("l2", "0"),
+             ("batch_size", "100"), ("max_num_epochs", "3"),
+             ("shuffle", "0"), ("report_interval", "0"),
+             ("stop_rel_objv", "0"), ("stop_val_auc", "-2"),
+             ("num_jobs_per_epoch", "1"), ("hash_capacity", str(1 << 20))])
+    seen = []
+    ln.add_epoch_end_callback(lambda e, t, v: seen.append(t.loss))
+    ln.run()
+    np.testing.assert_allclose(trajs[0]["train"], seen, rtol=2e-4)

@@ -81,3 +81,31 @@ def _run_cached_single(rcv1_path, over):
     if key not in _single_cache:
         _single_cache[key] = _run(rcv1_path, **over)
     return _single_cache[key]
+
+
+@pytest.fixture(scope="module")
+def uniform_path(tmp_path_factory):
+    """Synthetic uniform-width libsvm data (8 features/row): the panel
+    layout engages, so the mesh dispatches the panel + chunked-run step
+    instead of COO (round-4 verdict #1)."""
+    from conftest import write_uniform_libsvm
+    return write_uniform_libsvm(
+        tmp_path_factory.mktemp("uniform") / "uniform.libsvm")
+
+
+def test_mesh_panel_matches_single_device(uniform_path):
+    """The mesh panel + chunked-run train step (the round-5 fast path —
+    previously the mesh fell back to the unsorted COO backward) matches
+    the unsharded trajectory under dp-sharded, fs-sharded, and mixed
+    meshes, and actually engages (panel step counter)."""
+    base = dict(V_dim=2, V_threshold=2, lr=0.1, l1=0.1, l2=0,
+                max_num_epochs=3)
+    ref_ln, ref = _run(uniform_path, **base)
+    assert getattr(ref_ln, "_mesh_panel_steps", 0) == 0
+    for mesh_over in (dict(mesh_dp=2, mesh_fs=4), dict(mesh_dp=8),
+                      dict(mesh_fs=8)):
+        ln, seen = _run(uniform_path, **base, **mesh_over)
+        # streamed epochs dispatch through the panel path; replayed epochs
+        # rerun the staged PanelBatch payloads
+        assert getattr(ln, "_mesh_panel_steps", 0) > 0, mesh_over
+        np.testing.assert_allclose(seen, ref, rtol=1e-4)
