@@ -140,6 +140,12 @@ class Converter:
         mr = self.member_rows()
         log.info("rec members: %s rows each",
                  mr if mr > 0 else "one read chunk of")
+        if p.rec_batch_size == 0 and not p.batch_size and p.rec_localize:
+            log.warning(
+                "no batch_size given: members default to %d rows; pass "
+                "the training batch_size (or rec_batch_size) so members "
+                "come out batch-aligned — the cached reader re-compacts "
+                "every batch of an unaligned member", DEFAULT_MEMBER_ROWS)
         threads = p.convert_threads or min(6, os.cpu_count() or 1)
         split = p.part_size > 0
         limit = p.part_size * (1 << 20) if split else None

