@@ -45,6 +45,46 @@ def pad_slots_oob(slots: np.ndarray, cap: int, capacity: int) -> np.ndarray:
     return out.astype(np.int32)
 
 
+def hash_slots(rev_ids: np.ndarray, hash_capacity: int) -> np.ndarray:
+    """Byte-REVERSED uint64 ids -> int32 slots: the hashed store's single
+    slot-assignment rule (modulo into [1, capacity); row 0 stays
+    TRASH_SLOT). One definition shared by map_keys, the producer fast
+    paths (learners/sgd.py) and collision_stats, so the diagnostic can
+    never quietly diverge from the table."""
+    cap = np.uint64(hash_capacity - 1)
+    return (np.asarray(rev_ids, FEAID_DTYPE) % cap
+            + np.uint64(1)).astype(np.int32)
+
+
+def collision_stats(ids: np.ndarray, hash_capacity: int) -> dict:
+    """Hashed-store collision accounting for a set of distinct feature ids.
+
+    The reference's distributed SGD keys the model by exact 64-bit id
+    (unbounded unordered_maps, src/sgd/sgd_updater.h:141-176) so no two
+    features ever alias; the multi-host hashed store trades that for a
+    fixed capacity (SURVEY §7 hard part (d)). This quantifies the trade:
+    ``collided_frac`` is the fraction of distinct ids that share their
+    slot with at least one other id (those features' gradients merge
+    permanently). tools/collision_study.py turns this into measured AUC
+    at varying load factors.
+    """
+    ids = np.unique(np.asarray(ids, dtype=FEAID_DTYPE))
+    slots = hash_slots(reverse_bytes(ids), hash_capacity)
+    n = len(ids)
+    # O(n) accounting — a bincount over the table would allocate
+    # O(hash_capacity) (2 GB at a 2^28-row table) for any id count
+    _, occ = np.unique(slots, return_counts=True)
+    n_slots = len(occ)
+    collided = n - int((occ == 1).sum())
+    return {
+        "n_ids": n,
+        "hash_capacity": hash_capacity,
+        "load_factor": round(n / max(hash_capacity - 1, 1), 4),
+        "slots_used": n_slots,
+        "collided_frac": round(collided / max(n, 1), 4),
+    }
+
+
 class SlotStore:
     """Single-controller store over one (possibly sharded) slot table.
 
@@ -89,8 +129,7 @@ class SlotStore:
         input's appearance order."""
         keys = np.asarray(keys, dtype=FEAID_DTYPE)
         if self.hashed:
-            cap = np.uint64(self.param.hash_capacity - 1)
-            return (keys % cap + np.uint64(1)).astype(np.int32)
+            return hash_slots(keys, self.param.hash_capacity)
         n = len(self._keys)
         out = np.full(len(keys), TRASH_SLOT, dtype=np.int32)
         if n:
