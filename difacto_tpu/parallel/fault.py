@@ -114,6 +114,7 @@ class HeartbeatMonitor:
         self._stop = threading.Event()
         self._in_collective_since: Optional[float] = None
         self._collective_depth = 0
+        self._depth_lock = threading.Lock()
         self._threads = [
             threading.Thread(target=self._send_loop, daemon=True),
             threading.Thread(target=self._recv_loop, daemon=True),
@@ -187,19 +188,24 @@ class HeartbeatMonitor:
         """Context manager marking a collective in flight for the
         watchdog. Depth-counted and therefore REENTRANT: an epoch-long
         outer guard (the cached-replay loop) stays armed when inner
-        guarded() calls exit."""
+        guarded() calls exit. The depth is lock-protected because the
+        SPMD control-plane pipeline issues its allgathers from a
+        prefetch thread while the main thread may hold the epoch-long
+        drain guard."""
         mon = self
 
         class _Ctx:
             def __enter__(self):
-                mon._collective_depth += 1
-                if mon._collective_depth == 1:
-                    mon._in_collective_since = time.monotonic()
+                with mon._depth_lock:
+                    mon._collective_depth += 1
+                    if mon._collective_depth == 1:
+                        mon._in_collective_since = time.monotonic()
 
             def __exit__(self, *exc):
-                mon._collective_depth -= 1
-                if mon._collective_depth == 0:
-                    mon._in_collective_since = None
+                with mon._depth_lock:
+                    mon._collective_depth -= 1
+                    if mon._collective_depth == 0:
+                        mon._in_collective_since = None
                 return False
 
         return _Ctx()

@@ -55,6 +55,12 @@ def allgather_np(arr) -> "np.ndarray":
     [n_procs, *shape]. The DCN control channel of the synchronized-step
     schedule (the analog of ps-lite's scheduler barrier + key exchange,
     src/store/kvstore_dist.h:61-70). Single process: adds the leading axis.
+
+    NOTE this gather is itself a DEVICE program (process_allgather jits a
+    collective over the global devices), so it must be issued in exactly
+    the same order as every other device program on every host — only
+    call it from the thread that dispatches the device steps. A lookahead
+    thread must use :func:`control_allgather_np` instead.
     """
     import jax
     import numpy as np
@@ -62,6 +68,76 @@ def allgather_np(arr) -> "np.ndarray":
         return np.asarray(arr)[None]
     from jax.experimental import multihost_utils
     return np.asarray(multihost_utils.process_allgather(np.asarray(arr)))
+
+
+# --------------------------------------------------------------- control
+# Deviceless control plane over the jax.distributed KV store.
+
+_CTRL_TIMEOUT_MS = 600_000
+_ctrl_seq = 0
+_ctrl_bar = 0
+_ctrl_written: list = []
+
+
+def control_allgather_np(arr) -> "np.ndarray":
+    """Deviceless allgather over the jax.distributed KV store (pure gRPC
+    to the coordinator — the ps-lite-analog wire, SURVEY §5.8).
+
+    Unlike :func:`allgather_np`, this touches NO device, so the SPMD
+    schedule may run it from a lookahead thread and overlap the DCN
+    round trip with device execution (learners/sgd.py ``exchange()``).
+    Interleaving a device-collective allgather with the step stream from
+    two threads deadlocks — hosts would enqueue the same device programs
+    in different orders (measured: a 2-process virtual-mesh run hangs at
+    epoch 1 once compiles stop serializing the race).
+
+    All processes must call this the same number of times with the same
+    shape/dtype (one lookahead thread per host preserves that). Keys
+    accumulate in the coordinator until :func:`control_cleanup`.
+    """
+    import jax
+    import numpy as np
+    global _ctrl_seq
+    a = np.ascontiguousarray(np.asarray(arr))
+    if jax.process_count() == 1:
+        return a[None]
+    from jax._src import distributed
+    client = distributed.global_state.client
+    rank, n = jax.process_index(), jax.process_count()
+    key = f"difacto/ctrl/{_ctrl_seq}"
+    _ctrl_seq += 1
+    client.key_value_set_bytes(f"{key}/{rank}", a.tobytes())
+    _ctrl_written.append(f"{key}/{rank}")
+    out = np.empty((n,) + a.shape, a.dtype)
+    for r in range(n):
+        if r == rank:
+            out[r] = a
+        else:
+            b = client.blocking_key_value_get_bytes(f"{key}/{r}",
+                                                    _CTRL_TIMEOUT_MS)
+            out[r] = np.frombuffer(b, a.dtype).reshape(a.shape)
+    return out
+
+
+def control_cleanup() -> None:
+    """Delete this process's control keys once every peer has consumed
+    them. Call at a quiesce point all hosts reach together (the part
+    drain in the SPMD schedule); the barrier makes consumption global
+    before deletion, keeping the coordinator's KV memory bounded by one
+    part's payloads instead of the whole run's."""
+    import jax
+    global _ctrl_bar
+    if jax.process_count() == 1:
+        _ctrl_written.clear()
+        return
+    from jax._src import distributed
+    client = distributed.global_state.client
+    bar = _ctrl_bar
+    _ctrl_bar += 1
+    client.wait_at_barrier(f"difacto/ctrlbar/{bar}", _CTRL_TIMEOUT_MS)
+    for k in _ctrl_written:
+        client.key_value_delete(k)
+    _ctrl_written.clear()
 
 
 def to_local_numpy(arr) -> "np.ndarray":

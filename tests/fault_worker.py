@@ -53,7 +53,7 @@ def _die():
 
 if rank == 1 and attempt == "0" and mode == "allgather":
     import difacto_tpu.parallel.multihost as mh
-    _orig, _calls = mh.allgather_np, {"n": 0}
+    _orig, _calls = mh.control_allgather_np, {"n": 0}
 
     def _dying_allgather(arr):
         _calls["n"] += 1
@@ -61,7 +61,7 @@ if rank == 1 and attempt == "0" and mode == "allgather":
             _die()
         return _orig(arr)
 
-    mh.allgather_np = _dying_allgather
+    mh.control_allgather_np = _dying_allgather
 
 from difacto_tpu.learners import Learner  # noqa: E402
 
