@@ -55,6 +55,13 @@ class ConverterParam(Param):
     # training config auto-aligns members (see rec_batch_size)
     batch_size: int = 0
     convert_threads: int = 0  # 0 = auto
+    # zlib-compress rec members. Default OFF: the rec format exists to
+    # make STREAMING fast (the reference picked LZ4 for the same reason,
+    # src/data/compressed_row_block.h:20-142) and zlib decompress
+    # measured 68% of the streamed-epoch host-pack pass (1.32 of 1.93 s
+    # per 600k rows, docs/perf_notes.md "the streamed regime");
+    # uncompressed members are ~2.6x larger but read at page-cache speed
+    rec_compress: bool = False
 
 
 # auto member size when no batch_size is given: large enough that member
@@ -163,9 +170,10 @@ class Converter:
         def write_member(path: str, blk: RowBlock) -> int:
             if p.rec_localize:
                 cblk, uniq, _ = compact(blk)
-                write_rec_block(path, cblk, uniq=uniq)
+                write_rec_block(path, cblk, uniq=uniq,
+                                compress=p.rec_compress)
             else:
-                write_rec_block(path, blk)
+                write_rec_block(path, blk, compress=p.rec_compress)
             sz = stream.getsize(path)
             with written_lock:
                 written[0] += sz
