@@ -9,15 +9,38 @@ starts ``-n`` processes with the rendezvous env
 (DIFACTO_COORDINATOR/NPROCS/RANK -> jax.distributed.initialize, see
 difacto_tpu/parallel/multihost.py).
 
-Launch modes (--launcher, the dmlc-tracker cluster types):
+Launch modes (--launcher, the dmlc-tracker cluster types,
+reference launch.py:32-78):
   local  processes on this machine (default);
   ssh    one process per line of ``-H hostfile`` (the run_ssh.sh path,
          /root/reference/run_ssh.sh:1, example/ip_list.txt): the
          rendezvous coordinator is the first host, env rides the remote
          command line, and ``--sync-dst-dir`` rsyncs the working dir to
-         every host first (dmlc-tracker's sync behavior). On managed
-         clusters (k8s/xpk/slurm, the yarn equivalents) the scheduler
-         sets the DIFACTO_* variables itself — no launcher needed.
+         every host first (dmlc-tracker's sync behavior);
+  mpi    one ``mpirun`` over the allocation; each MPI rank runs this
+         script's ``shim`` mode, which maps the MPI rank env
+         (OMPI_COMM_WORLD_RANK / PMI_RANK / PMIX_RANK) to DIFACTO_RANK
+         and resolves the coordinator through a shared rendezvous dir;
+  sge    a ``qsub`` array job (-t 1-N, $SGE_TASK_ID-1 = rank) whose
+         tasks run the shim; the launcher polls per-rank rc files on
+         the shared filesystem until the job completes;
+  yarn   a YARN distributed-shell submission (-num_containers N);
+         containers carry no rank, so the shim atomically CLAIMS one
+         via O_EXCL files in the rendezvous dir.
+
+The cluster modes share one protocol (the dmlc-tracker equivalent): every
+task runs ``launch.py shim``, which (1) determines its rank, (2) writes
+its hostname to ``<rendezvous-dir>/host-<rank>``, (3) polls for
+``host-0`` (rank 0 must be the jax.distributed coordinator), (4) execs
+the training command with the DIFACTO_* rendezvous env, and (5) records
+its exit code in ``rc-<rank>``. The rendezvous dir must be on a
+filesystem all tasks share (SGE/YARN clusters have one; MPI allocations
+usually share $HOME); each submission works in its own ``run-*`` subdir,
+so the recovery unit for cluster modes is a whole resubmission (fresh
+subdir + ckpt auto_resume), not a per-task rerun — a rerun inside one
+submission would meet the first attempt's claim/rc files. Schedulers
+that pre-assign stable host lists (k8s/xpk/slurm) can skip the launcher
+entirely and set the DIFACTO_* variables themselves.
 
 ``--max-restarts k`` adds the recovery loop of the dead-host protocol
 (difacto_tpu/parallel/fault.py): heartbeat env is exported so workers
@@ -159,7 +182,221 @@ def _run_once(cmd, n, hosts, port, attempt, args):
                 p.kill()
 
 
+# --------------------------------------------------------------- cluster
+# mpi/sge/yarn support: shared-filesystem rendezvous + rank shim.
+
+_MPI_RANK_VARS = ("OMPI_COMM_WORLD_RANK", "PMIX_RANK", "PMI_RANK",
+                  "SLURM_PROCID")
+
+
+def _claim_rank(rdv: str, n: int) -> int:
+    """Atomically claim the lowest free rank via O_EXCL claim files —
+    for schedulers whose tasks carry no rank of their own (yarn
+    distributed-shell containers)."""
+    import socket
+    for rank in range(n):
+        try:
+            fd = os.open(os.path.join(rdv, f"claim-{rank}"),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, socket.gethostname().encode())
+            os.close(fd)
+            return rank
+        except FileExistsError:
+            continue
+    raise SystemExit(f"all {n} ranks already claimed in {rdv}")
+
+
+def _write_rc(rdv: str, rank: int, rc: int) -> None:
+    with open(os.path.join(rdv, f"rc-{rank}.tmp"), "w") as f:
+        f.write(str(rc))
+    os.replace(os.path.join(rdv, f"rc-{rank}.tmp"),
+               os.path.join(rdv, f"rc-{rank}"))
+
+
+def run_shim(args, cmd) -> int:
+    """Per-task half of the cluster protocol (see module docstring)."""
+    import socket
+    rdv = args.rendezvous_dir
+    os.makedirs(rdv, exist_ok=True)
+    if args.rank >= 0:
+        rank = args.rank
+    else:
+        rank = next((int(os.environ[v]) for v in _MPI_RANK_VARS
+                     if v in os.environ), -1)
+        if rank < 0:
+            rank = _claim_rank(rdv, args.num_processes)
+    # from here the rank is known: ANY exit must leave an rc file, or the
+    # launcher's rc-file wait (default: no deadline) would spin forever
+    # on a shim that failed before running the command
+    try:
+        rc = _run_shim_ranked(args, cmd, rdv, rank, socket.gethostname())
+    except BaseException:
+        _write_rc(rdv, rank, 1)
+        raise
+    _write_rc(rdv, rank, rc)
+    return rc
+
+
+def _run_shim_ranked(args, cmd, rdv: str, rank: int, hostname: str) -> int:
+    host_f = os.path.join(rdv, f"host-{rank}")
+    with open(host_f + ".tmp", "w") as f:
+        f.write(hostname)
+    os.replace(host_f + ".tmp", host_f)
+    # rank 0 IS the jax.distributed coordinator; the full host list also
+    # feeds the UDP heartbeat mesh (fault.py) so a dead container aborts
+    # its peers fast instead of leaving them blocked in a collective —
+    # so every task waits for ALL host files (they appear during the
+    # same startup window as host-0)
+    deadline = time.time() + args.rendezvous_timeout
+    hosts = [None] * args.num_processes
+    while any(h is None for h in hosts):
+        for r in range(args.num_processes):
+            if hosts[r] is None:
+                p = os.path.join(rdv, f"host-{r}")
+                if os.path.exists(p):
+                    with open(p) as f:
+                        hosts[r] = f.read().strip()
+        if any(h is None for h in hosts):
+            if time.time() > deadline:
+                missing = [r for r, h in enumerate(hosts) if h is None]
+                raise SystemExit(
+                    f"rendezvous timeout: no host file for rank(s) "
+                    f"{missing} in {rdv}")
+            time.sleep(0.2)
+    env = dict(os.environ)
+    env.update({
+        "DIFACTO_COORDINATOR": f"{hosts[0]}:{args.port}",
+        "DIFACTO_NPROCS": str(args.num_processes),
+        "DIFACTO_RANK": str(rank),
+        "DIFACTO_HB_PORT": str(args.hb_port),
+        "DIFACTO_HB_TIMEOUT": str(args.hb_timeout),
+        "DIFACTO_HB_PEERS": ",".join(hosts),
+    })
+    return subprocess.call(cmd, env=env)
+
+
+def _shim_cmd(args, cmd, rank_expr=None) -> str:
+    """Shell line that runs this script in shim mode on a cluster task."""
+    base = [sys.executable if args.local_python else "python",
+            os.path.abspath(__file__), "shim",
+            "--rendezvous-dir", args.rendezvous_dir,
+            "--port", str(args.port),
+            "-n", str(args.num_processes),
+            "--rendezvous-timeout", str(args.rendezvous_timeout),
+            "--hb-port", str(args.hb_port),
+            "--hb-timeout", str(args.hb_timeout)]
+    line = " ".join(shlex.quote(c) for c in base)
+    if rank_expr is not None:
+        line += f" --rank {rank_expr}"
+    return line + " -- " + " ".join(shlex.quote(c) for c in cmd)
+
+
+def _wait_cluster_rcs(rdv: str, n: int, timeout: float) -> int:
+    """Poll the shims' rc files; first nonzero rc wins (matching
+    _run_once's semantics). ``timeout`` bounds the WHOLE job
+    (--job-timeout; 0 = wait forever) — it is deliberately separate from
+    --rendezvous-timeout, which bounds only task startup: a training run
+    outlives any sane rendezvous deadline."""
+    deadline = time.time() + timeout if timeout > 0 else None
+    seen = {}
+    while len(seen) < n:
+        for rank in range(n):
+            if rank in seen:
+                continue
+            p = os.path.join(rdv, f"rc-{rank}")
+            if os.path.exists(p):
+                with open(p) as f:
+                    seen[rank] = int(f.read().strip() or "1")
+        if len(seen) < n and deadline is not None and time.time() > deadline:
+            missing = [r for r in range(n) if r not in seen]
+            print(f"[launch] timeout waiting for rank(s) {missing} in {rdv}",
+                  file=sys.stderr)
+            return 1
+        time.sleep(0.2)
+    bad = [rc for rc in seen.values() if rc != 0]
+    return bad[0] if bad else 0
+
+
+def run_cluster(args, cmd) -> int:
+    """Submit through mpirun / qsub / yarn and wait on the rendezvous
+    dir's rc files (the dmlc-tracker submit equivalents,
+    reference launch.py:32-78, run_yarn.sh:3)."""
+    n = args.num_processes or 1
+    args.num_processes = n
+    if args.max_restarts > 0:
+        # resubmission is the scheduler's job in these modes (qsub/yarn
+        # retry policies; mpirun has none) — failing fast beats silently
+        # running without the recovery the user asked for. The shims DO
+        # start the heartbeat mesh, so peer death still aborts fast.
+        # NOTE the retry unit is the WHOLE job (a fresh launch.py
+        # submission gets a fresh run-* rendezvous subdir): per-task
+        # reruns inside one submission would meet the first attempt's
+        # claim/rc files and be reported as that attempt's result.
+        raise SystemExit(
+            f"--max-restarts is not supported with --launcher "
+            f"{args.launcher}: have the scheduler retry the WHOLE "
+            "submission (each gets a fresh rendezvous subdir) with "
+            "ckpt_interval/auto_resume in the trained config")
+    if not args.rendezvous_dir:
+        raise SystemExit(f"--launcher {args.launcher} requires "
+                         "--rendezvous-dir on a shared filesystem")
+    # unique per-submission subdir: reusing a rendezvous dir would hand
+    # new tasks the PREVIOUS run's claim/host/rc files (ranks 'already
+    # claimed', stale coordinator, rc collection reporting the old
+    # run's result). The submit time + pid make the path unique; the
+    # shims receive it fully resolved on their command line.
+    args.rendezvous_dir = os.path.join(
+        args.rendezvous_dir, f"run-{int(time.time())}-{os.getpid()}")
+    rdv = args.rendezvous_dir
+    os.makedirs(rdv, exist_ok=False)
+    if args.launcher == "mpi":
+        # one mpirun across the allocation; ranks come from the MPI env
+        full = (args.mpirun_cmd.split() + ["-np", str(n)]
+                + ["/bin/sh", "-c", _shim_cmd(args, cmd)])
+        rc = subprocess.call(full)
+        if rc != 0:
+            return rc
+        return _wait_cluster_rcs(rdv, n, args.job_timeout)
+    if args.launcher == "sge":
+        # array job: $SGE_TASK_ID is 1-based
+        script = os.path.join(rdv, "job.sh")
+        with open(script, "w") as f:
+            f.write("#!/bin/sh\n"
+                    f"#$ -t 1-{n}\n#$ -cwd\n#$ -S /bin/sh\n"
+                    + _shim_cmd(args, cmd,
+                                rank_expr="$((SGE_TASK_ID-1))") + "\n")
+        os.chmod(script, 0o755)
+        rc = subprocess.call(args.qsub_cmd.split() + [script])
+        if rc != 0:
+            return rc
+        return _wait_cluster_rcs(rdv, n, args.job_timeout)
+    # yarn distributed shell: containers carry no rank -> shims claim one
+    full = (args.yarn_cmd.split()
+            + ["-num_containers", str(n),
+               "-shell_command", _shim_cmd(args, cmd)])
+    rc = subprocess.call(full)
+    if rc != 0:
+        return rc
+    return _wait_cluster_rcs(rdv, n, args.job_timeout)
+
+
 def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "shim":
+        sp = argparse.ArgumentParser(prog="launch.py shim")
+        sp.add_argument("--rendezvous-dir", required=True)
+        sp.add_argument("--port", type=int, default=7799)
+        sp.add_argument("-n", "--num-processes", type=int, required=True)
+        sp.add_argument("--rank", type=int, default=-1)
+        sp.add_argument("--rendezvous-timeout", type=float, default=300.0)
+        sp.add_argument("--hb-port", type=int, default=29800)
+        sp.add_argument("--hb-timeout", type=float, default=5.0)
+        sp.add_argument("cmd", nargs=argparse.REMAINDER)
+        sa = sp.parse_args(sys.argv[2:])
+        scmd = sa.cmd[1:] if sa.cmd and sa.cmd[0] == "--" else sa.cmd
+        if not scmd:
+            sp.error("no command given")
+        return run_shim(sa, scmd)
+
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--num-processes", type=int, default=0,
                     help="process count (default: 1, or the hostfile "
@@ -167,8 +404,34 @@ def main() -> int:
     ap.add_argument("-H", "--hostfile", default="",
                     help="one host per line (# comments ok); used by the "
                          "ssh launcher, reference example/ip_list.txt")
-    ap.add_argument("--launcher", choices=("local", "ssh"),
+    ap.add_argument("--launcher",
+                    choices=("local", "ssh", "mpi", "sge", "yarn"),
                     default="local")
+    ap.add_argument("--rendezvous-dir", default="",
+                    help="shared-filesystem dir for the cluster modes' "
+                         "host/rank rendezvous (mpi/sge/yarn)")
+    ap.add_argument("--rendezvous-timeout", type=float, default=300.0,
+                    help="seconds each cluster task waits for its peers' "
+                         "host files at STARTUP (mpi/sge/yarn)")
+    ap.add_argument("--job-timeout", type=float, default=0.0,
+                    help="seconds to wait for the WHOLE cluster job's rc "
+                         "files after submission; 0 (default) waits "
+                         "forever — training runs outlive any sane "
+                         "rendezvous deadline, so this is a separate "
+                         "knob")
+    ap.add_argument("--mpirun-cmd", default="mpirun",
+                    help="mpirun executable + base flags (mpi mode)")
+    ap.add_argument("--qsub-cmd", default="qsub",
+                    help="qsub executable + base flags (sge mode)")
+    ap.add_argument("--yarn-cmd",
+                    default="yarn org.apache.hadoop.yarn.applications."
+                            "distributedshell.Client",
+                    help="yarn distributed-shell client + base flags "
+                         "(yarn mode; point -jar etc. here)")
+    ap.add_argument("--local-python", action="store_true",
+                    help="cluster tasks run this exact interpreter "
+                         "(sys.executable) instead of 'python' from the "
+                         "remote PATH — for single-machine tests")
     ap.add_argument("--sync-dst-dir", default="",
                     help="rsync the current directory to this path on "
                          "every host before launching (ssh mode)")
@@ -193,6 +456,9 @@ def main() -> int:
     cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
     if not cmd:
         ap.error("no command given")
+
+    if args.launcher in ("mpi", "sge", "yarn"):
+        return run_cluster(args, cmd)
 
     hosts = []
     if args.launcher == "ssh":

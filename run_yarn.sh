@@ -1,0 +1,10 @@
+#!/bin/bash
+# YARN cluster run (reference run_yarn.sh equivalent, which submitted 50
+# workers + 50 servers through dmlc-tracker): here the yarn
+# distributed-shell client starts N rankless containers; each runs the
+# launch.py shim, claims a rank through the shared rendezvous dir, and
+# joins the SPMD rendezvous (rank 0 = coordinator). The rendezvous dir
+# must be on a filesystem every container mounts.
+python launch.py --launcher yarn -n 8 \
+    --rendezvous-dir /shared/difacto_rdv \
+    -- python -m difacto_tpu examples/local.conf "$@"

@@ -1,0 +1,169 @@
+"""launch.py cluster modes (mpi/sge/yarn — the remaining dmlc-tracker
+launchers, reference launch.py:32-78, run_yarn.sh:3) — exercised with fake
+mpirun/qsub/yarn shims that run the submitted tasks locally, so the tests
+need no real scheduler: rank mapping from the MPI env, SGE array-task
+ranks, O_EXCL rank claiming for rankless YARN containers, shared-dir
+rendezvous (rank 0 = coordinator), and rc-file collection."""
+
+import json
+import os
+import pathlib
+import stat
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# fake mpirun: run -np N copies of the command locally with the OpenMPI
+# rank env set (what a real mpirun does on its allocation)
+FAKE_MPIRUN = """#!/bin/sh
+np=0
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -np) np="$2"; shift 2 ;;
+    *) break ;;
+  esac
+done
+i=0
+while [ $i -lt $np ]; do
+  OMPI_COMM_WORLD_RANK=$i "$@" &
+  i=$((i+1))
+done
+wait
+"""
+
+# fake qsub: run the array job's tasks locally ($SGE_TASK_ID is 1-based),
+# return immediately after spawning (qsub is submit-and-exit)
+FAKE_QSUB = """#!/bin/sh
+script="$1"
+n=$(sed -n 's/^#\\$ -t 1-\\([0-9]*\\)$/\\1/p' "$script")
+i=1
+while [ $i -le $n ]; do
+  SGE_TASK_ID=$i sh "$script" &
+  i=$((i+1))
+done
+exit 0
+"""
+
+# fake yarn distributed-shell client: spawn -num_containers copies of
+# -shell_command with NO rank information (containers claim ranks)
+FAKE_YARN = """#!/bin/sh
+n=1; cmd=""
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -num_containers) n="$2"; shift 2 ;;
+    -shell_command) cmd="$2"; shift 2 ;;
+    *) shift ;;
+  esac
+done
+i=0
+while [ $i -lt $n ]; do
+  sh -c "$cmd" &
+  i=$((i+1))
+done
+exit 0
+"""
+
+
+def _shim(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    return p
+
+
+def _worker(tmp_path):
+    w = tmp_path / "worker.py"
+    w.write_text(
+        "import json, os, sys\n"
+        "out = sys.argv[1]\n"
+        "rank = os.environ['DIFACTO_RANK']\n"
+        "with open(f'{out}/r{rank}.json', 'w') as f:\n"
+        "    json.dump({k: v for k, v in os.environ.items()\n"
+        "               if k.startswith('DIFACTO')}, f)\n")
+    return w
+
+
+def _run_dir(rdv):
+    """The per-submission run-* subdir (stale-state isolation)."""
+    runs = sorted(rdv.glob("run-*"))
+    assert len(runs) == 1, runs
+    return runs[0]
+
+
+def _run(tmp_path, launcher, extra):
+    worker = _worker(tmp_path)
+    rdv = tmp_path / "rdv"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "launch.py"), "--launcher", launcher,
+         "-n", "3", "--rendezvous-dir", str(rdv), "--local-python",
+         "--rendezvous-timeout", "60", "--port", "7971"] + extra
+        + ["--", sys.executable, str(worker), str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    envs = {}
+    for r in range(3):
+        with open(tmp_path / f"r{r}.json") as f:
+            envs[r] = json.load(f)
+    for r in range(3):
+        assert envs[r]["DIFACTO_NPROCS"] == "3"
+        assert envs[r]["DIFACTO_RANK"] == str(r)
+        # every task resolved the SAME coordinator (rank 0's host)
+        assert envs[r]["DIFACTO_COORDINATOR"] == \
+            envs[0]["DIFACTO_COORDINATOR"]
+        # and the shims exported the heartbeat mesh env (fast abort on
+        # container death even without launcher-side restarts)
+        assert envs[r]["DIFACTO_HB_PEERS"].count(",") == 2
+    # the shims recorded their exit codes
+    run = _run_dir(rdv)
+    for r in range(3):
+        assert (run / f"rc-{r}").read_text() == "0"
+    return envs
+
+
+def test_mpi_launcher(tmp_path):
+    shim = _shim(tmp_path, "fake_mpirun", FAKE_MPIRUN)
+    _run(tmp_path, "mpi", ["--mpirun-cmd", str(shim)])
+
+
+def test_sge_launcher(tmp_path):
+    shim = _shim(tmp_path, "fake_qsub", FAKE_QSUB)
+    _run(tmp_path, "sge", ["--qsub-cmd", str(shim)])
+    # the generated array-job script carries the task range
+    job = (_run_dir(tmp_path / "rdv") / "job.sh").read_text()
+    assert "#$ -t 1-3" in job and "SGE_TASK_ID" in job
+
+
+def test_yarn_launcher_claims_ranks(tmp_path):
+    shim = _shim(tmp_path, "fake_yarn", FAKE_YARN)
+    _run(tmp_path, "yarn", ["--yarn-cmd", str(shim)])
+    # rankless containers each claimed a distinct rank file
+    claims = sorted(p.name
+                    for p in _run_dir(tmp_path / "rdv").glob("claim-*"))
+    assert claims == ["claim-0", "claim-1", "claim-2"]
+
+
+def test_cluster_rejects_max_restarts(tmp_path):
+    # resubmission is the scheduler's job in cluster modes: asking for
+    # launcher-side restarts must fail fast, not silently not-restart
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "launch.py"), "--launcher", "mpi",
+         "-n", "2", "--rendezvous-dir", str(tmp_path / "rdv"),
+         "--max-restarts", "1", "--", "true"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+    assert "max-restarts" in proc.stderr
+
+
+def test_cluster_failure_rc_propagates(tmp_path):
+    shim = _shim(tmp_path, "fake_mpirun", FAKE_MPIRUN)
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys; sys.exit(7)\n")
+    rdv = tmp_path / "rdv"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "launch.py"), "--launcher", "mpi",
+         "-n", "2", "--rendezvous-dir", str(rdv), "--local-python",
+         "--mpirun-cmd", str(shim), "--rendezvous-timeout", "60",
+         "--", sys.executable, str(bad)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 7
