@@ -248,6 +248,19 @@ class SGDLearnerParam(Param):
     # per-step training metric: "binned" = O(B) histogram AUC (default),
     # "exact" = argsort AUC, "none". Validation is always exact (step.py).
     train_auc: str = "binned"
+    # STREAMED panel training (no replay cache): build the chunked-run
+    # backward layout on the producer threads so streamed steps take the
+    # fast chunked step instead of the unsorted scatter (39 vs 73 ms at
+    # bench shapes). OFF by default: the host sort measures ~9 us/example
+    # /core against ~0.5 us/example of device time saved (an 18x core-
+    # to-chip ratio), so it only pays on hosts with abundant spare cores
+    # per chip AND num_producers raised to match. Ignored while a device
+    # cache is staging (the staging-time device chunker derives the same
+    # layout from buffers already on the chip — shipping host-built
+    # chunks would double the staged bytes on the slow link). Chunking
+    # ON DEVICE per step was also measured out (221 ms/step). Numbers:
+    # docs/perf_notes.md "streamed chunking".
+    stream_chunks: bool = False
     # HBM budget for the device-resident batch replay cache (0 disables).
     # Single-host hashed-store runs stage each packed batch once and replay
     # it from device memory every later epoch — essential when the
@@ -952,7 +965,8 @@ class SGDLearner(Learner):
 
     def _prepare_hashed(self, blk, want_counts: bool, fill_counts: bool,
                         dim_min: int, job: str,
-                        b_cap: Optional[int] = None):
+                        b_cap: Optional[int] = None,
+                        stream_chunk: bool = False):
         """Producer-thread batch preparation for the hashed store: ONE
         int32 np.unique collapses localization (Localizer::Compact),
         key->slot mapping, and collision dedup, then the batch packs into
@@ -988,6 +1002,11 @@ class SGDLearner(Learner):
             width = self._shapes.cap(job + ".w", width, exact=True)
             i32, f32, binary = pack_panel(
                 cblk, n_uniq, padded, b_cap, width, u_cap, counts=counts)
+            if stream_chunk:
+                return ("panel_chunked", i32, f32,
+                        self._chunk_host(i32, f32, b_cap, width, u_cap,
+                                         binary),
+                        binary, b_cap, width, u_cap, False)
             return ("panel", i32, f32, binary, b_cap, width, u_cap, False)
         from ..ops.batch import pack_batch
         nnz_cap = self._shapes.cap(job + ".nnz", blk.nnz, dim_min)
@@ -995,9 +1014,24 @@ class SGDLearner(Learner):
             cblk, n_uniq, padded, b_cap, nnz_cap, u_cap, counts=counts)
         return ("coo", i32, f32, binary, b_cap, nnz_cap, u_cap, False)
 
+    def _chunk_host(self, i32: np.ndarray, f32: np.ndarray, b_cap: int,
+                    width: int, u_cap: int, binary: bool):
+        """Producer-thread chunked-run layout for a packed panel (the host
+        twin of the staging-time device chunker _panel_chunk_packed):
+        streamed runs then dispatch the fast chunked step instead of the
+        unsorted scatter. Ragged panels always carry explicit values
+        (zero on pad cells, ops/batch._panel_arrays), so pad tokens
+        contribute nothing through chunk_vals; uniform binary panels have
+        no pad cells."""
+        from ..ops.batch import panel_chunk_tokens_np
+        cells = b_cap * width
+        fv = None if binary else f32[:cells]
+        return panel_chunk_tokens_np(i32[:cells], fv, u_cap, b_cap, width)
+
     def _prepare_from_uniq(self, cblk, uniq, counts, want_counts: bool,
                            fill_counts: bool, dim_min: int, job: str,
-                           b_cap: Optional[int] = None):
+                           b_cap: Optional[int] = None,
+                           stream_chunk: bool = False):
         """Cached fast path (data/cached.py): the block arrives already
         localized to ``uniq`` (sorted reversed ids), so host work is just
         the O(uniq) slot map + dedup; the O(nnz) index array ships
@@ -1029,6 +1063,13 @@ class SGDLearner(Learner):
             i32, f32, binary = pack_panel(
                 cblk, n_lanes, padded, b_cap, width, u_cap,
                 counts=scounts, remap=remap32)
+            if stream_chunk:
+                # chunk lanes live in uniq-lane space; the step's remap
+                # permutation (pull/push_grads) applies unchanged
+                return ("panel_chunked", i32, f32,
+                        self._chunk_host(i32, f32, b_cap, width, u_cap,
+                                         binary),
+                        binary, b_cap, width, u_cap, True)
             return ("panel", i32, f32, binary, b_cap, width, u_cap, True)
         from ..ops.batch import pack_batch
         nnz_cap = self._shapes.cap(job + ".nnz", cblk.nnz, dim_min)
@@ -1217,6 +1258,18 @@ class SGDLearner(Learner):
         # flipping the has_cnt static and recompiling every shape variant
         want_counts = is_train and self.do_embedding
         job = "train" if is_train else "eval"
+        n_workers = p.num_producers or max(1, min(4, os.cpu_count() or 1))
+        # producer-side chunked-run layout for panel training: streamed
+        # steps take the fast chunked step instead of the unsorted
+        # scatter, with the host sort on the producer threads. Off while
+        # the cache may still stage — there the device chunker builds
+        # the same layout from buffers already on the chip, and host
+        # chunks would double the bytes staged over the slow link.
+        # Opt-in — see SGDLearnerParam.stream_chunks for the core math.
+        cache_may_stage = (cache is not None and cache.alive
+                           and not cache.frozen)
+        stream_chunk = (is_train and hashed_fast and p.stream_chunks
+                        and not cache_may_stage)
 
         def make_iter(part):
             # EVERYTHING host-side happens on producer threads so it
@@ -1239,7 +1292,8 @@ class SGDLearner(Learner):
                         yield ("ready", sub, self._prepare_from_uniq(
                             sub, uniq, cnts, want_counts, push_cnt,
                             dim_min, job,
-                            b_cap_train if is_train else None))
+                            b_cap_train if is_train else None,
+                            stream_chunk=stream_chunk))
                     else:
                         yield ("compact", sub, (sub, uniq, cnts))
                 return
@@ -1248,7 +1302,8 @@ class SGDLearner(Learner):
                 if hashed_fast:
                     yield ("ready", blk, self._prepare_hashed(
                         blk, want_counts, push_cnt, dim_min, job,
-                        b_cap_train if is_train else None))
+                        b_cap_train if is_train else None,
+                        stream_chunk=stream_chunk))
                 else:
                     yield ("compact", blk, compact(blk,
                                                    need_counts=push_cnt))
@@ -1256,7 +1311,6 @@ class SGDLearner(Learner):
         from ..data.producer_pool import OrderedProducerPool
         from ..tracker.workload_pool import (WorkloadPool,
                                              WorkloadPoolParam)
-        n_workers = p.num_producers or max(1, min(4, os.cpu_count() or 1))
         wp = WorkloadPool(WorkloadPoolParam(
             straggler_timeout=p.straggler_timeout))
         # the pool runs over the parts still streamed this epoch (all of
@@ -1354,12 +1408,25 @@ class SGDLearner(Learner):
         kind, blk, payload = item
         is_train = job_type == K_TRAINING
         if kind == "ready":
-            layout, i32, f32, binary, b_cap, d2, u_cap, has_rm = payload
-            i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
+            if payload[0] == "panel_chunked":
+                # producer-side chunked layout (stream_chunks): the host
+                # sort already ran on the producer thread, so both
+                # streamed dispatch AND cache staging use these chunks
+                (_, i32, f32, (ci_np, cl_np, cv_np), binary, b_cap, d2,
+                 u_cap, has_rm) = payload
+                layout = "panel"
+                i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
+                ci, cl = jnp.asarray(ci_np), jnp.asarray(cl_np)
+                cv = None if cv_np is None else jnp.asarray(cv_np)
+                chunked = True
+            else:
+                layout, i32, f32, binary, b_cap, d2, u_cap, has_rm = payload
+                i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
+                chunked = False
             wc = want_counts if is_train else False
             staging = (cache is not None and cache.staging
                        and layout == "panel" and is_train)
-            if staging:
+            if staging and not chunked:
                 # cache-eligible panel training: build the chunked-run
                 # layout ONCE at staging time and dispatch epoch 0 through
                 # the SAME chunked step the replays use — one compiled
@@ -1367,6 +1434,8 @@ class SGDLearner(Learner):
                 # backward (docs/perf_notes.md)
                 ci, cl, cv = self._panel_chunk_packed(i32, f32, b_cap, d2,
                                                       u_cap, binary)
+                chunked = True
+            if chunked:
                 dev_payload = ("panel_chunked", i32, f32, ci, cl, cv, b_cap,
                                d2, u_cap, wc, binary, has_rm, blk.size)
             else:
@@ -1381,7 +1450,7 @@ class SGDLearner(Learner):
                 if wc and push_cnt:
                     f32 = self._zero_counts(f32, u_cap)
                 nbytes = i32.nbytes + f32.nbytes
-                if staging:
+                if chunked and is_train:
                     nbytes += ci.nbytes + cl.nbytes + (
                         0 if cv is None else cv.nbytes)
                     cache.add(part,
