@@ -1164,6 +1164,25 @@ class SGDLearner(Learner):
                 stage_after_pass=0 if self.store.hashed else 1)
         return self._dev_caches[job_type]
 
+    def device_cache_info(self) -> dict:
+        """Replay-cache coverage after a run, per job type: ``complete``
+        means steady epochs replay entirely from HBM; ``frozen`` means the
+        budget filled mid-staging and steady epochs are a MIXED regime
+        (the staged part prefix replays, the tail streams). Lets callers
+        (bench.py e2e) label a "replay" rate honestly instead of assuming
+        full coverage."""
+        out = {}
+        for jt, c in getattr(self, "_dev_caches", {}).items():
+            out[jt] = {
+                "complete": bool(c.ready and c.alive and not c.frozen),
+                # an invalidated cache keeps its frozen flag but holds no
+                # entries — that run is fully streaming, not mixed
+                "frozen": bool(c.frozen and c.entries),
+                "staged_parts": len(c.entries),
+                "staged_mb": round(c.used / (1 << 20), 1),
+            }
+        return out
+
     def _replay_cached(self, job_type: int, epoch: int,
                        cache: _DeviceBatchCache, prog: Progress) -> None:
         """Steady-state epoch: replay HBM-resident staged batches — zero
