@@ -352,15 +352,28 @@ class SGDLearner(Learner):
                 self._spmd_b_cap = bucket(self.param.batch_size, dmin)
                 self._spmd_nnz_cap = self.param.nnz_cap or auto
                 self._spmd_u_cap = self.param.uniq_cap or auto
-            if not self.store.hashed:
-                # per-host slot assignment would silently train independent
-                # replicas that never communicate — a correctness footgun,
-                # not a mode (round-1 verdict item 7)
+            # Both store modes work over a multi-host MESH. Hashed: slot
+            # assignment is stateless modular hashing, identical on every
+            # host for free. Dictionary (exact 64-bit ids, the reference's
+            # server design — src/sgd/sgd_updater.h:141-176 grows
+            # unordered_maps keyed by feature id, so no two features ever
+            # alias): the synchronized schedule's control plane ships raw
+            # uint64 ids instead of slots, and every host inserts the SAME
+            # sorted id union into its dictionary in the same order, so
+            # the replica id->slot maps stay bit-identical with no extra
+            # communication rounds (_iterate_data_spmd exchange()).
+            if self.mesh is None and not self.store.hashed:
+                # without the mesh schedule there is no per-step exchange:
+                # per-host slot assignment would silently train
+                # independent replicas that never communicate — a
+                # correctness footgun, not a mode (round-1 verdict item 7)
                 raise ValueError(
-                    "multi-host runs require the hashed store "
-                    "(set hash_capacity > 0): the dictionary store assigns "
-                    "slots per-host, so hosts would train independent "
-                    "models that never synchronize")
+                    "multi-host runs without a mesh require the hashed "
+                    "store (set hash_capacity > 0, or set mesh_dp/mesh_fs "
+                    "for the synchronized-step schedule): the dictionary "
+                    "store assigns slots per-host outside the mesh "
+                    "schedule, so hosts would train independent models "
+                    "that never synchronize")
         self._build_steps()
         return remain
 
@@ -589,8 +602,10 @@ class SGDLearner(Learner):
         """Load the newest interval checkpoint (ckpt_interval/auto_resume;
         the recovery leg of parallel/fault.py). Returns the completed epoch
         or None. A host joining after an eviction may not have written the
-        part file itself — any rank's part works, because the hashed-store
-        state is host-complete (replicated over dp, multihost.py)."""
+        part file itself — any rank's part works, because the store state
+        is host-complete in both modes (table replicated over dp; the
+        dictionary replicas are bit-identical by construction,
+        multihost.py)."""
         import json
 
         from ..utils import stream
@@ -617,13 +632,20 @@ class SGDLearner(Learner):
             cache = self._get_cache(job_type)
             cached_parts: set = set()
             if cache is not None and cache.ready:
-                # replay the staged prefix; a partial cache streams the
-                # remaining parts below (same canonical part order: the
-                # cached set is a prefix, _DeviceBatchCache._freeze)
-                self._replay_cached(job_type, epoch, cache, prog)
-                if not cache.partial:
-                    return
-                cached_parts = cache.parts()
+                if (cache.capacity is not None
+                        and cache.capacity != self.store.state.capacity):
+                    # staged slot padding is only truthful at the staging
+                    # capacity (pad_slots_oob); the dictionary store can
+                    # grow if genuinely-new ids arrive after staging
+                    cache.invalidate("store capacity changed since staging")
+                else:
+                    # replay the staged prefix; a partial cache streams the
+                    # remaining parts below (same canonical part order: the
+                    # cached set is a prefix, _DeviceBatchCache._freeze)
+                    self._replay_cached(job_type, epoch, cache, prog)
+                    if not cache.partial:
+                        return
+                    cached_parts = cache.parts()
             for part in range(n_jobs):
                 if part in cached_parts:
                     continue
@@ -697,9 +719,11 @@ class SGDLearner(Learner):
 
         Protocol per step, identical on every host:
         1. read the next LOCAL batch (or none — this host is out of data);
-        2. allgather [local slot list | local counts | rows | has-data] over
-           DCN (parallel/multihost.py);
-        3. every host deterministically computes the slot UNION -> the
+        2. allgather [local key list | local counts | nu | fmax | rows |
+           has-data] over DCN (parallel/multihost.py) — keys are int32
+           slots in hashed mode, raw uint64 feature ids in dictionary mode
+           (see exchange());
+        3. every host deterministically computes the key UNION -> the
            replicated scatter/gather index vector, and remaps its local COO
            columns into union positions;
         4. run the SAME jitted train/eval step over the global mesh: batch
@@ -710,7 +734,6 @@ class SGDLearner(Learner):
         from ..parallel import put_dp_local, put_global, replicated
         from ..parallel.multihost import control_allgather_np, \
             control_cleanup
-        from ..updaters.sgd_updater import TRASH_SLOT
 
         p = self.param
         cache = self._get_cache(job_type)
@@ -749,9 +772,10 @@ class SGDLearner(Learner):
             ahead of the device dispatch on a prefetch thread (round-4
             verdict weak #6: the synchronous per-step DCN allgather used
             to sit between device steps; now it overlaps them). Yields
-            fully staged (batch, slots_dev, counts_dev, nrows, cblk)
-            tuples; the main thread only applies counts (store-state
-            order) and dispatches steps. Every host runs this stage in
+            fully staged (batch, slots_dev, counts_dev, nrows, cblk,
+            grow) tuples; the main thread only applies deferred
+            dictionary growth and counts (store-state order) and
+            dispatches steps. Every host runs this stage in
             the same step order, so the cross-host collective sequence
             is unchanged — just earlier.
 
@@ -761,26 +785,46 @@ class SGDLearner(Learner):
             the dispatch loop on single-CPU hosts (GIL churn against the
             collective's busy-wait)."""
             it = iter(produce())
+            hashed = self.store.hashed
+            # dictionary mode defers device-state growth to the dispatch
+            # thread (map_keys(grow=False) + grow markers in the yielded
+            # tuples): growing here would swap the table buffers under an
+            # in-flight step. cap_logical tracks the capacity the dispatch
+            # thread WILL have when each batch steps, so the OOB slot
+            # padding below is computed against the right table size.
+            cap_logical = self.store.state.capacity
             while True:
                 item = next(it, None)
-                # [slots(u) | counts(u) if push_cnt | fmax | nrows | has]
-                # — the counts half is only shipped on the epoch-0 count
-                # push; fmax (this host's max row nnz) lets every host
-                # agree on the panel-vs-COO layout for the step. int32:
-                # slots index the (< 2^31) table, counts are bounded by
-                # nnz_cap — half the DCN bytes of the original int64
-                # payload.
-                payload = np.zeros((2 * u_cap if push_cnt else u_cap) + 3,
-                                   dtype=np.int32)
+                # [keys(u) | counts(u) if push_cnt | nu | fmax | nrows |
+                # has] — the counts half is only shipped on the epoch-0
+                # count push; fmax (this host's max row nnz) lets every
+                # host agree on the panel-vs-COO layout for the step.
+                # Hashed store: keys are int32 slots (stateless modular
+                # hashing is host-consistent for free). Dictionary store:
+                # keys are the raw uint64 feature ids — every host inserts
+                # the identical sorted id UNION into its dictionary in the
+                # same order each step, so the replica id->slot maps stay
+                # bit-identical (the reference's exact-id server design,
+                # src/sgd/sgd_updater.h:141-176, at 2x the control bytes).
+                payload = np.zeros((2 * u_cap if push_cnt else u_cap) + 4,
+                                   dtype=np.int32 if hashed else np.uint64)
                 cblk = slots_np = None
+                uniq = None
                 if item is not None:
                     blk, (cblk, uniq, cnts) = item
-                    slots_np, remap, cnts = self.store.map_keys_dedup(
-                        uniq, cnts)
-                    if remap is not None:
-                        cblk = dataclasses.replace(
-                            cblk, index=remap[cblk.index].astype(np.uint32))
-                    nu = len(slots_np)
+                    if hashed:
+                        slots_np, remap, cnts = self.store.map_keys_dedup(
+                            uniq, cnts)
+                        if remap is not None:
+                            cblk = dataclasses.replace(
+                                cblk,
+                                index=remap[cblk.index].astype(np.uint32))
+                        local_keys = slots_np
+                    else:
+                        # sorted unique byte-reversed ids from compact();
+                        # mapping to slots happens after the union below
+                        local_keys = uniq
+                    nu = len(local_keys)
                     if nu > u_cap or blk.nnz > nnz_cap or blk.size > b_cap:
                         raise ValueError(
                             f"batch (rows={blk.size}, nnz={blk.nnz}, "
@@ -789,10 +833,12 @@ class SGDLearner(Learner):
                             f"uniq_cap={u_cap}); raise nnz_cap/uniq_cap in "
                             "the config (b_cap follows batch_size — raise "
                             "batch_size if rows exceed it)")
-                    payload[:nu] = slots_np
+                    payload[:nu] = local_keys
                     if push_cnt and cnts is not None:
-                        payload[u_cap:u_cap + nu] = cnts.astype(np.int32)
+                        payload[u_cap:u_cap + nu] = cnts.astype(
+                            payload.dtype)
                     counts_r = np.diff(cblk.offset)
+                    payload[-4] = nu
                     payload[-3] = int(counts_r.max()) if len(counts_r) else 0
                     payload[-2] = blk.size
                     payload[-1] = 1
@@ -807,26 +853,61 @@ class SGDLearner(Learner):
                 if self.monitor is not None:
                     g = self.monitor.guarded(control_allgather_np, payload)
                 else:
-                    g = control_allgather_np(payload)  # [n_hosts, (2u|u)+3]
+                    g = control_allgather_np(payload)  # [n_hosts, (2u|u)+4]
                 if g[:, -1].max() == 0:
                     return
-                union = np.unique(g[:, :u_cap])
-                union = union[union != TRASH_SLOT].astype(np.int32)
-                gu = len(union)
+                nus = g[:, -4].astype(np.int64)
+                spans = [g[h, :nus[h]] for h in range(g.shape[0]) if nus[h]]
+                union = (np.unique(np.concatenate(spans)) if spans
+                         else np.empty(0, payload.dtype))
+                grow = None
+                if hashed:
+                    # union is already the sorted unique global slot list
+                    slots_sorted = union.astype(np.int32)
+                    rank = None
+                else:
+                    # deterministic replica insert: identical union array +
+                    # identical prior dictionary => identical new-slot
+                    # assignment on every host (induction from empty)
+                    slots_u = self.store.map_keys(union, grow=False)
+                    new_cap = self.store.capacity_for(
+                        self.store.next_slot, current=cap_logical)
+                    if new_cap != cap_logical:
+                        cap_logical = grow = new_cap
+                    # dictionary slots are insertion-ordered, the device
+                    # kernels need them sorted ascending — sort, and keep
+                    # the rank permutation to translate union positions
+                    order = np.argsort(slots_u)
+                    slots_sorted = slots_u[order].astype(np.int32)
+                    rank = np.empty(len(order), dtype=np.int64)
+                    rank[order] = np.arange(len(order))
+                gu = len(slots_sorted)
                 gu_cap = bucket(gu)
                 from ..store.local import pad_slots_oob
-                slots_g = pad_slots_oob(union, gu_cap,
-                                        self.store.state.capacity)
+                slots_g = pad_slots_oob(slots_sorted, gu_cap, cap_logical)
                 slots_dev = put_global(slots_g, replicated(self.mesh))
                 cts_dev = None
                 if push_cnt:
                     cts = np.zeros(gu_cap, dtype=np.float64)
                     for h in range(g.shape[0]):
-                        hs, hc = g[h, :u_cap], g[h, u_cap:2 * u_cap]
-                        m = hs != TRASH_SLOT
-                        np.add.at(cts, np.searchsorted(union, hs[m]), hc[m])
+                        k = int(nus[h])
+                        hs, hc = g[h, :k], g[h, u_cap:u_cap + k]
+                        pos = np.searchsorted(union, hs)
+                        if rank is not None:
+                            pos = rank[pos]
+                        np.add.at(cts, pos, hc.astype(np.float64))
                     cts_dev = put_global(cts.astype(np.float32),
                                          replicated(self.mesh))
+                # this host's localized column ids -> positions in the
+                # sorted global slot list (shared by the panel + COO
+                # layouts below)
+                pos_local = None
+                if cblk is not None:
+                    if hashed:
+                        pos_local = np.searchsorted(union, slots_np)
+                    else:
+                        pos_local = rank[np.searchsorted(union, uniq)]
+                    pos_local = pos_local.astype(np.int64)
 
                 nrows_g = int(g[:, -2].sum())
                 fmax_g = int(g[:, -3].max())
@@ -843,7 +924,6 @@ class SGDLearner(Learner):
                                                  exact=True)
                     cblk2 = None
                     if cblk is not None:
-                        pos_local = np.searchsorted(union, slots_np)
                         cblk2 = dataclasses.replace(
                             cblk,
                             index=pos_local[cblk.index].astype(np.uint32))
@@ -887,8 +967,6 @@ class SGDLearner(Learner):
                         base = self._host_rank * b_cap
                         rows[:nnz] = cblk.row_ids() + base
                         rows[nnz:] = base + max(b - 1, 0)
-                        pos_local = np.searchsorted(
-                            union, slots_np).astype(np.int32)
                         cols[:nnz] = pos_local[cblk.index]
                         vals[:nnz] = cblk.values_or_ones()
                         labels[:b] = cblk.label
@@ -909,11 +987,16 @@ class SGDLearner(Learner):
                         num_uniq=put_global(np.int32(gu),
                                             replicated(self.mesh)),
                     )
-                yield batch, slots_dev, cts_dev, nrows_g, cblk
+                yield batch, slots_dev, cts_dev, nrows_g, cblk, grow
 
         pending: list = []
-        for batch, slots_dev, cts_dev, nrows_g, cblk in prefetch(
+        for batch, slots_dev, cts_dev, nrows_g, cblk, grow in prefetch(
                 exchange(), depth=2):
+            if grow is not None:
+                # deferred dictionary growth (see exchange()): applied in
+                # step order on this thread, BEFORE the first step whose
+                # slots address the grown table
+                self.store.grow_to(grow)
             if cts_dev is not None:
                 # epoch-0 feature-count push; applied on the main thread
                 # so store-state mutations stay ordered with the steps
@@ -943,7 +1026,8 @@ class SGDLearner(Learner):
                 # uniform mesh), so alive flips in lockstep
                 cache.add(part_idx,
                           ("devbatch", batch, slots_dev, nrows_g),
-                          self._payload_nbytes((batch, slots_dev)))
+                          self._payload_nbytes((batch, slots_dev)),
+                          capacity=self.store.state.capacity)
             pending.append((nrows_g, objv, auc))
 
         # draining the pending step results blocks on device programs that

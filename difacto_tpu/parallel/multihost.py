@@ -13,10 +13,16 @@ its own byte-range file parts (``host_part`` -> Reader(part_idx,
 num_parts)), the WorkloadPool semantics move one level up.
 
 For the model state to be identical across controllers the feature ->
-slot mapping must be deterministic without cross-host chatter — use the
-hashed store mode (store/local.py ``hash_capacity``), which maps ids to
-slots by modular hashing of the byte-reversed id (SURVEY §7 "fixed-capacity
-hashed embedding table").
+slot mapping must be host-consistent. Both store modes achieve it:
+the hashed store (store/local.py ``hash_capacity``) maps ids to slots by
+stateless modular hashing of the byte-reversed id (SURVEY §7
+"fixed-capacity hashed embedding table"); the exact-id dictionary store
+rides the synchronized schedule's control plane — the per-step exchange
+ships raw uint64 ids and every host inserts the identical sorted union
+into its dictionary in the same order, so replica id->slot maps stay
+bit-identical with no extra rounds (learners/sgd.py exchange(); the
+reference's servers key the model by exact 64-bit id the same way,
+src/sgd/sgd_updater.h:141-176).
 """
 
 from __future__ import annotations

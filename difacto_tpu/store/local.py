@@ -94,8 +94,8 @@ class SlotStore:
     in/out shardings so the table never leaves its layout.
     """
 
-    def __init__(self, param: SGDUpdaterParam, initial_capacity: int = 1 << 14,
-                 mesh=None):
+    def __init__(self, param: SGDUpdaterParam,
+                 initial_capacity: Optional[int] = None, mesh=None):
         self.param = param
         self.fns = make_fns(param)
         self.mesh = mesh
@@ -108,6 +108,8 @@ class SlotStore:
         self._keys = np.empty(0, dtype=FEAID_DTYPE)
         self._slots = np.empty(0, dtype=np.int64)
         self._next_slot = TRASH_SLOT + 1
+        if initial_capacity is None:
+            initial_capacity = param.init_capacity
         cap = param.hash_capacity if self.hashed else initial_capacity
         self.state: SGDState = self._place(init_state(param, cap))
 
@@ -122,11 +124,24 @@ class SlotStore:
     def num_features(self) -> int:
         return len(self._keys)
 
-    def map_keys(self, keys: np.ndarray, insert: bool = True) -> np.ndarray:
+    @property
+    def next_slot(self) -> int:
+        """One past the highest assigned slot — deferred-growth callers
+        (map_keys(grow=False)) compare this against the device capacity."""
+        return self._next_slot
+
+    def map_keys(self, keys: np.ndarray, insert: bool = True,
+                 grow: bool = True) -> np.ndarray:
         """Map *unique* uint64 ids -> int32 slots; unknown ids are inserted
         (the reference's operator[] inserts on Get too, sgd_updater.cc:46) or
         mapped to TRASH_SLOT when insert=False. New slots are assigned in the
-        input's appearance order."""
+        input's appearance order.
+
+        ``grow=False`` records the inserted keys but does NOT grow the
+        device state — for callers on a lookahead thread (the SPMD control
+        plane) that must not swap the table buffers under an in-flight
+        step; they call :meth:`grow_to` from the dispatch thread before
+        the first step that uses the new slots."""
         keys = np.asarray(keys, dtype=FEAID_DTYPE)
         if self.hashed:
             return hash_slots(keys, self.param.hash_capacity)
@@ -152,7 +167,8 @@ class SlotStore:
                 pos = np.searchsorted(self._keys, nk)
                 self._keys = np.insert(self._keys, pos, nk)
                 self._slots = np.insert(self._slots, pos, ns)
-                self._ensure_capacity(self._next_slot)
+                if grow:
+                    self._ensure_capacity(self._next_slot)
         return out
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
@@ -186,13 +202,30 @@ class SlotStore:
             return uniq.astype(np.int32), inv, counts
         return slots, None, counts
 
-    def _ensure_capacity(self, need: int) -> None:
-        cap = self.state.capacity
-        if need <= cap:
-            return
+    def capacity_for(self, need: int, current: Optional[int] = None) -> int:
+        """The table capacity after growing ``current`` (default: the live
+        capacity) to hold ``need`` slots — the single definition of the
+        doubling rule, shared with deferred-growth callers (the SPMD
+        exchange computes OOB slot padding against the capacity the
+        dispatch thread WILL have, so both sites must agree)."""
+        cap = self.state.capacity if current is None else current
         while cap < need:
             cap *= 2
+        return cap
+
+    def _ensure_capacity(self, need: int) -> None:
+        cap = self.capacity_for(need)
+        if cap == self.state.capacity:
+            return
         self.state = self._place(grow_state(self.param, self.state, cap))
+
+    def grow_to(self, capacity: int) -> None:
+        """Grow the device state to exactly ``capacity`` rows (a power-of-two
+        multiple of the current capacity, as tracked by a deferred-growth
+        caller — see map_keys(grow=False)). No-op when already there."""
+        if capacity > self.state.capacity:
+            self.state = self._place(grow_state(self.param, self.state,
+                                                capacity))
 
     def pad_slots(self, slots: np.ndarray, cap: int) -> jnp.ndarray:
         """Pad sorted unique slots to ``cap`` with ASCENDING out-of-bounds

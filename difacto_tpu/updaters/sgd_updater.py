@@ -64,6 +64,10 @@ class SGDUpdaterParam(Param):
     # across hosts (multi-controller requirement, parallel/multihost.py);
     # collisions alias features, the standard hashing-trick tradeoff.
     hash_capacity: int = 0
+    # dictionary store only: initial slot-table rows (grows by doubling,
+    # store/local.py). Lower it to bound the first HBM allocation on
+    # small models — or, in tests, to force growth events.
+    init_capacity: int = field(default=1 << 14, metadata=dict(lo=2))
     # storage dtype of the fused [V | Vg] embedding rows. bfloat16 halves
     # the dominant HBM traffic of the fused step (the [U, 2k] row
     # gather/scatter); compute stays float32. FTRL scalars (w/z/sqrt_g)

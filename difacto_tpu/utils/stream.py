@@ -46,12 +46,24 @@ def _scheme(uri: str) -> str:
     return uri.split("://", 1)[0] + "://"
 
 
+def _ensure_parent(path: str) -> None:
+    """Create a local write target's missing parent directories
+    (model_out/pred_out prefixes point into run directories that may not
+    exist yet; fsspec remote writes already auto-mkdir)."""
+    if os.path.dirname(path):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+
+
 def open_stream(uri: str, mode: str = "rb") -> IO:
-    """Open a local path or remote URI for reading/writing."""
+    """Open a local path or remote URI for reading/writing. Local writes
+    create missing parent directories."""
     if is_remote(uri):
         fs, path = _fs(uri)
         return fs.open(path, mode)
-    return open(_strip_file_scheme(uri), mode)
+    path = _strip_file_scheme(uri)
+    if "w" in mode or "a" in mode:
+        _ensure_parent(path)
+    return open(path, mode)
 
 
 def exists(uri: str) -> bool:
@@ -147,6 +159,7 @@ def save_npz(uri: str, compress: bool = True, **arrays) -> None:
             f.write(buf.getvalue())
         return
     path = _strip_file_scheme(uri)
+    _ensure_parent(path)
     tmp = path + ".tmp.npz"  # .npz suffix stops savez appending its own
     save(tmp, **arrays)
     os.replace(tmp, path)

@@ -3,7 +3,12 @@ launch.py with 2 processes, each holding 4 virtual CPU devices, training
 over a global (dp=2, fs=4) mesh. Dumps the per-epoch loss trajectory as
 JSON so the parent can compare ranks against the single-host reference.
 
-Usage: spmd_worker.py <out_dir> <data_path> [epochs]
+Usage: spmd_worker.py <out_dir> <data_path> [epochs] [data_val] [k=v ...]
+
+Trailing ``k=v`` pairs override the base config — e.g. ``hash_capacity=0``
+switches to the exact-id dictionary store, whose replica dictionaries stay
+host-consistent through the id-exchange control plane (learners/sgd.py
+exchange()).
 """
 import json
 import os
@@ -25,19 +30,23 @@ out_dir, data = sys.argv[1], sys.argv[2]
 epochs = int(sys.argv[3]) if len(sys.argv) > 3 else 4
 data_val = sys.argv[4] if len(sys.argv) > 4 else ""
 
-args = [("data_in", data), ("V_dim", "2"), ("V_threshold", "2"),
-        ("lr", "0.1"), ("l1", "0.1"), ("l2", "0"),
-        ("batch_size", "100"), ("max_num_epochs", str(epochs)),
-        ("shuffle", "0"), ("report_interval", "0"),
-        ("stop_rel_objv", "0"), ("stop_val_auc", "-2"),
-        ("num_jobs_per_epoch", "1"),
-        ("hash_capacity", str(1 << 20)),
-        ("mesh_dp", "2"), ("mesh_fs", "4"),
-        ("model_out", os.path.join(out_dir, "model"))]
+conf = {"data_in": data, "V_dim": "2", "V_threshold": "2",
+        "lr": "0.1", "l1": "0.1", "l2": "0",
+        "batch_size": "100", "max_num_epochs": str(epochs),
+        "shuffle": "0", "report_interval": "0",
+        "stop_rel_objv": "0", "stop_val_auc": "-2",
+        "num_jobs_per_epoch": "1",
+        "hash_capacity": str(1 << 20),
+        "mesh_dp": "2", "mesh_fs": "4",
+        "model_out": os.path.join(out_dir, "model")}
 if data_val:
     # exercises the SPMD eval path: Reader chunks larger than b_cap must be
     # sliced into batch_size row windows (advisor round-2 medium finding)
-    args.append(("data_val", data_val))
+    conf["data_val"] = data_val
+for kv in sys.argv[5:]:
+    k, v = kv.split("=", 1)
+    conf[k] = v
+args = list(conf.items())
 ln = Learner.create("sgd")
 ln.init(args)
 seen, seen_val = [], []
@@ -48,5 +57,9 @@ ln.run()
 rank = jax.process_index()
 with open(os.path.join(out_dir, f"traj-{rank}.json"), "w") as f:
     json.dump({"train": seen, "val": seen_val,
-               "panel_steps": getattr(ln, "_spmd_panel_steps", 0)}, f)
+               "panel_steps": getattr(ln, "_spmd_panel_steps", 0),
+               # dictionary-replica invariants: every rank must hold the
+               # identical id->slot map and table capacity
+               "num_features": ln.store.num_features,
+               "capacity": int(ln.store.state.capacity)}, f)
 print(f"rank {rank} done: {seen}")

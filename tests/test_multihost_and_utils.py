@@ -41,14 +41,44 @@ def test_hashed_store_trains(rcv1_path):
                                np.asarray(ln.store.state.w))
 
 
-def test_multihost_dictionary_store_rejected(rcv1_path, monkeypatch):
-    """Multi-host + dictionary store must error (per-host slot assignment
-    would train independent replicas), pointing at hash_capacity."""
+def test_multihost_dictionary_store_rejected_without_mesh(rcv1_path,
+                                                          monkeypatch):
+    """Multi-host + dictionary store WITHOUT a mesh must error (outside
+    the synchronized-step schedule there is no id exchange, so per-host
+    slot assignment would train independent replicas), pointing at
+    hash_capacity. WITH a mesh the dictionary store is supported — the
+    control plane ships raw ids (tests/test_multihost_spmd.py)."""
     import difacto_tpu.parallel.multihost as mh
     monkeypatch.setattr(mh, "host_part", lambda: (0, 2))
     ln = Learner.create("sgd")
     with pytest.raises(ValueError, match="hash_capacity"):
         ln.init([("data_in", rcv1_path)])
+
+
+def test_map_keys_deferred_growth():
+    """map_keys(grow=False) records inserts without touching the device
+    state; grow_to applies the doubling later (the SPMD lookahead thread
+    protocol — learners/sgd.py exchange())."""
+    from difacto_tpu.store.local import SlotStore
+    from difacto_tpu.updaters.sgd_updater import SGDUpdaterParam
+    st = SlotStore(SGDUpdaterParam.init_allow_unknown(
+        [("init_capacity", "4")])[0])
+    assert st.state.capacity == 4
+    keys = np.arange(1, 11, dtype=np.uint64)
+    slots = st.map_keys(keys, grow=False)
+    # slots assigned beyond the device capacity, state untouched
+    assert st.next_slot == 11
+    assert st.state.capacity == 4
+    cap = 4
+    while st.next_slot > cap:
+        cap *= 2
+    st.grow_to(cap)
+    assert st.state.capacity == 16
+    # the mapping is stable and a second lookup agrees
+    np.testing.assert_array_equal(st.map_keys(keys), slots)
+    # grown rows are addressable
+    w, _, _ = st.pull(keys)
+    assert w.shape == (10,)
 
 
 def test_hashed_push_collision_aggregates():

@@ -20,17 +20,20 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 EPOCHS = 4
 
 
-def _single_host_reference(rcv1_path, data_val):
+def _single_host_reference(rcv1_path, data_val, **overrides):
     from difacto_tpu.learners import Learner
+    conf = {"data_in": rcv1_path, "V_dim": "2", "V_threshold": "2",
+            "lr": "0.1", "l1": "0.1", "l2": "0",
+            "batch_size": "100", "max_num_epochs": str(EPOCHS),
+            "shuffle": "0", "report_interval": "0",
+            "stop_rel_objv": "0", "stop_val_auc": "-2",
+            "num_jobs_per_epoch": "1",
+            "hash_capacity": str(1 << 20)}
+    if data_val:
+        conf["data_val"] = data_val
+    conf.update({k: str(v) for k, v in overrides.items()})
     ln = Learner.create("sgd")
-    ln.init([("data_in", rcv1_path), ("V_dim", "2"), ("V_threshold", "2"),
-             ("lr", "0.1"), ("l1", "0.1"), ("l2", "0"),
-             ("batch_size", "100"), ("max_num_epochs", str(EPOCHS)),
-             ("shuffle", "0"), ("report_interval", "0"),
-             ("stop_rel_objv", "0"), ("stop_val_auc", "-2"),
-             ("num_jobs_per_epoch", "1"),
-             ("data_val", data_val),
-             ("hash_capacity", str(1 << 20))])
+    ln.init(list(conf.items()))
     seen, seen_val = [], []
     ln.add_epoch_end_callback(
         lambda e, t, v: (seen.append(t.loss), seen_val.append(v.loss)))
@@ -47,22 +50,8 @@ def test_two_process_mesh_matches_single_host(rcv1_path, tmp_path):
     with open(val_path, "w") as f:
         f.write(text * 3)
 
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)  # worker sets its own 4-device flag
-    env["PYTHONPATH"] = str(REPO)
-    proc = subprocess.run(
-        [sys.executable, str(REPO / "launch.py"), "-n", "2",
-         "--port", "7921", "--",
-         sys.executable, str(REPO / "tests" / "spmd_worker.py"),
-         str(tmp_path), rcv1_path, str(EPOCHS), val_path],
-        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=600)
-    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\n" \
-                                 f"stderr:\n{proc.stderr}"
-
-    trajs = []
-    for rank in range(2):
-        with open(tmp_path / f"traj-{rank}.json") as f:
-            trajs.append(json.load(f))
+    trajs = _launch_two(tmp_path, rcv1_path, EPOCHS, 7921,
+                        data_val=val_path)
     # both ranks observed the identical global trajectory
     np.testing.assert_allclose(trajs[0]["train"], trajs[1]["train"],
                                rtol=0, atol=0)
@@ -83,6 +72,64 @@ def test_two_process_mesh_matches_single_host(rcv1_path, tmp_path):
     assert (tmp_path / "model_part-1").exists()
 
 
+def _launch_two(tmp_path, data, epochs, port, extra=(), data_val=""):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker sets its own 4-device flag
+    env["PYTHONPATH"] = str(REPO)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "launch.py"), "-n", "2",
+         "--port", str(port), "--",
+         sys.executable, str(REPO / "tests" / "spmd_worker.py"),
+         str(tmp_path), data, str(epochs), data_val, *extra],
+        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\n" \
+                                 f"stderr:\n{proc.stderr}"
+    trajs = []
+    for rank in range(2):
+        with open(tmp_path / f"traj-{rank}.json") as f:
+            trajs.append(json.load(f))
+    return trajs
+
+
+def test_two_process_dictionary_matches_single_host(rcv1_path, tmp_path):
+    """Exact-id dictionary store over two hosts (round-4 missing #1: the
+    reference keys its distributed model by exact 64-bit feature id,
+    src/sgd/sgd_updater.h:141-176 — no two features ever alias). The
+    control plane ships raw ids; every host inserts the identical sorted
+    union, so replica dictionaries stay bit-identical. V_dim=0 makes the
+    trajectory slot-numbering-invariant, so the 2-process run must match
+    a single-host dictionary run."""
+    trajs = _launch_two(tmp_path, rcv1_path, EPOCHS, 7927,
+                        extra=["hash_capacity=0", "V_dim=0"])
+    np.testing.assert_allclose(trajs[0]["train"], trajs[1]["train"],
+                               rtol=0, atol=0)
+    # replica-dictionary invariants: identical id->slot maps and capacity
+    assert trajs[0]["num_features"] == trajs[1]["num_features"] > 0
+    assert trajs[0]["capacity"] == trajs[1]["capacity"]
+
+    ref, _ = _single_host_reference(rcv1_path, "", hash_capacity=0,
+                                    V_dim=0)
+    np.testing.assert_allclose(trajs[0]["train"], ref, rtol=2e-4)
+
+
+def test_two_process_dictionary_growth_and_embeddings(rcv1_path, tmp_path):
+    """Dictionary SPMD with embeddings and a small init_capacity: the
+    table must grow by doubling mid-epoch-0 through the DEFERRED growth
+    path (exchange() computes OOB padding against the capacity the
+    dispatch thread will have; grow_to applies it in step order). Ranks
+    must stay bit-identical and the objective must fall. The rcv1
+    fixture has 2775 distinct features, so init_capacity=1024 forces
+    1024 -> 4096."""
+    trajs = _launch_two(tmp_path, rcv1_path, 3, 7929,
+                        extra=["hash_capacity=0", "init_capacity=1024"])
+    np.testing.assert_allclose(trajs[0]["train"], trajs[1]["train"],
+                               rtol=0, atol=0)
+    assert trajs[0]["num_features"] == trajs[1]["num_features"] == 2775
+    assert trajs[0]["capacity"] == trajs[1]["capacity"] == 4096
+    losses = trajs[0]["train"]
+    assert losses[-1] < losses[0]
+
+
 def test_two_process_mesh_panel_path(tmp_path):
     """Uniform-width data engages the SPMD panel + chunked-run step
     (round-5: the synchronized schedule previously always built COO
@@ -92,21 +139,7 @@ def test_two_process_mesh_panel_path(tmp_path):
     from conftest import write_uniform_libsvm
     data = write_uniform_libsvm(tmp_path / "uniform.libsvm", rows=100)
 
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = str(REPO)
-    proc = subprocess.run(
-        [sys.executable, str(REPO / "launch.py"), "-n", "2",
-         "--port", "7925", "--",
-         sys.executable, str(REPO / "tests" / "spmd_worker.py"),
-         str(tmp_path), data, "3"],
-        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=600)
-    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\n" \
-                                 f"stderr:\n{proc.stderr}"
-    trajs = []
-    for rank in range(2):
-        with open(tmp_path / f"traj-{rank}.json") as f:
-            trajs.append(json.load(f))
+    trajs = _launch_two(tmp_path, data, 3, 7925)
     assert trajs[0]["panel_steps"] > 0 and trajs[1]["panel_steps"] > 0
     np.testing.assert_allclose(trajs[0]["train"], trajs[1]["train"],
                                rtol=0, atol=0)
