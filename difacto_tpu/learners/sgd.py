@@ -323,6 +323,11 @@ class SGDLearner(Learner):
                 f"unknown train_auc {self.param.train_auc!r} "
                 "(expected binned|exact|none)")
         self._shapes = _ShapeSchedule()
+        # job types whose data THIS process has fully passed over once —
+        # after that the SPMD dictionary exchange ships slots instead of
+        # ids (every id is known; a resumed process starts empty because
+        # checkpoints drop all-zero entries, so its first pass re-inserts)
+        self._dict_ids_done: set = set()
         # multi-controller: this host owns a contiguous slice of the global
         # file parts (parallel/multihost.py; the reference's Rank()/
         # NumWorkers() reader sharding)
@@ -653,6 +658,9 @@ class SGDLearner(Learner):
                                   auc=prog.auc)
                 self._iterate_data_spmd(job_type, epoch, part, n_jobs, prog)
                 self._report_part(job_type, before, prog)
+            # a full pass completed: the dictionary now holds every id of
+            # this job's data, so later streamed passes exchange slots
+            self._dict_ids_done.add(job_type)
             if cache is not None and not cache.ready:
                 cache.finish_pass()
             return
@@ -793,6 +801,18 @@ class SGDLearner(Learner):
             # thread WILL have when each batch steps, so the OOB slot
             # padding below is computed against the right table size.
             cap_logical = self.store.state.capacity
+            # id-exchange is only needed while the dictionary can still
+            # gain entries: the first full pass over this job's data (or
+            # every pass when training resamples rows). Afterwards every
+            # id is known on every host, so streamed passes ship int32
+            # slots — half the DCN control bytes, no union re-insert.
+            # This is the regime the >HBM (1TB) config lives in: replay
+            # epochs skip DCN entirely, but a dataset that cannot replay
+            # pays the exchange every step of every epoch.
+            use_ids = (not hashed
+                       and (job_type not in self._dict_ids_done
+                            or (job_type == K_TRAINING
+                                and p.neg_sampling != 1)))
             while True:
                 item = next(it, None)
                 # [keys(u) | counts(u) if push_cnt | nu | fmax | nrows |
@@ -800,19 +820,27 @@ class SGDLearner(Learner):
                 # count push; fmax (this host's max row nnz) lets every
                 # host agree on the panel-vs-COO layout for the step.
                 # Hashed store: keys are int32 slots (stateless modular
-                # hashing is host-consistent for free). Dictionary store:
-                # keys are the raw uint64 feature ids — every host inserts
-                # the identical sorted id UNION into its dictionary in the
-                # same order each step, so the replica id->slot maps stay
-                # bit-identical (the reference's exact-id server design,
-                # src/sgd/sgd_updater.h:141-176, at 2x the control bytes).
+                # hashing is host-consistent for free). Dictionary store,
+                # first pass (use_ids): keys are the raw uint64 feature
+                # ids — every host inserts the identical sorted id UNION
+                # into its dictionary in the same order each step, so the
+                # replica id->slot maps stay bit-identical (the
+                # reference's exact-id server design,
+                # src/sgd/sgd_updater.h:141-176, at 2x the control
+                # bytes). Dictionary, later passes: int32 slots like the
+                # hashed store — the dictionary is complete, so lookups
+                # suffice and the payload halves.
                 payload = np.zeros((2 * u_cap if push_cnt else u_cap) + 4,
-                                   dtype=np.int32 if hashed else np.uint64)
+                                   dtype=np.uint64 if use_ids else np.int32)
                 cblk = slots_np = None
                 uniq = None
                 if item is not None:
                     blk, (cblk, uniq, cnts) = item
-                    if hashed:
+                    if use_ids:
+                        # sorted unique byte-reversed ids from compact();
+                        # mapping to slots happens after the union below
+                        local_keys = uniq
+                    elif hashed:
                         slots_np, remap, cnts = self.store.map_keys_dedup(
                             uniq, cnts)
                         if remap is not None:
@@ -821,9 +849,29 @@ class SGDLearner(Learner):
                                 index=remap[cblk.index].astype(np.uint32))
                         local_keys = slots_np
                     else:
-                        # sorted unique byte-reversed ids from compact();
-                        # mapping to slots happens after the union below
-                        local_keys = uniq
+                        # dictionary slot mode (every pass after the
+                        # first): all ids are known, ship their slots
+                        slots_l = self.store.lookup(uniq)
+                        from ..updaters.sgd_updater import TRASH_SLOT
+                        if (slots_l == TRASH_SLOT).any():
+                            raise RuntimeError(
+                                "dictionary slot-exchange saw an unknown "
+                                "feature id after the first pass — the "
+                                "input data changed between epochs "
+                                "(fixed data inserts every id on pass 0)")
+                        # dictionary slots are insertion-ordered; the
+                        # schedule needs them sorted with the COO columns
+                        # remapped to match
+                        slots_np, remap = np.unique(slots_l,
+                                                    return_inverse=True)
+                        slots_np = slots_np.astype(np.int32)
+                        cblk = dataclasses.replace(
+                            cblk, index=remap[cblk.index].astype(np.uint32))
+                        # counts never reach this branch: push_cnt is
+                        # epoch-0-only and epoch 0 always runs in id mode
+                        local_keys = slots_np
+                        self._spmd_slot_steps = getattr(
+                            self, "_spmd_slot_steps", 0) + 1
                     nu = len(local_keys)
                     if nu > u_cap or blk.nnz > nnz_cap or blk.size > b_cap:
                         raise ValueError(
@@ -861,7 +909,7 @@ class SGDLearner(Learner):
                 union = (np.unique(np.concatenate(spans)) if spans
                          else np.empty(0, payload.dtype))
                 grow = None
-                if hashed:
+                if not use_ids:
                     # union is already the sorted unique global slot list
                     slots_sorted = union.astype(np.int32)
                     rank = None
@@ -903,10 +951,10 @@ class SGDLearner(Learner):
                 # layouts below)
                 pos_local = None
                 if cblk is not None:
-                    if hashed:
-                        pos_local = np.searchsorted(union, slots_np)
-                    else:
+                    if use_ids:
                         pos_local = rank[np.searchsorted(union, uniq)]
+                    else:
+                        pos_local = np.searchsorted(union, slots_np)
                     pos_local = pos_local.astype(np.int64)
 
                 nrows_g = int(g[:, -2].sum())

@@ -58,6 +58,9 @@ rank = jax.process_index()
 with open(os.path.join(out_dir, f"traj-{rank}.json"), "w") as f:
     json.dump({"train": seen, "val": seen_val,
                "panel_steps": getattr(ln, "_spmd_panel_steps", 0),
+               # dictionary passes after the first exchange int32 slots
+               # instead of uint64 ids (half the DCN control bytes)
+               "slot_steps": getattr(ln, "_spmd_slot_steps", 0),
                # dictionary-replica invariants: every rank must hold the
                # identical id->slot map and table capacity
                "num_features": ln.store.num_features,

@@ -106,6 +106,9 @@ def test_two_process_dictionary_matches_single_host(rcv1_path, tmp_path):
     # replica-dictionary invariants: identical id->slot maps and capacity
     assert trajs[0]["num_features"] == trajs[1]["num_features"] > 0
     assert trajs[0]["capacity"] == trajs[1]["capacity"]
+    # passes after the first ship int32 slots instead of uint64 ids
+    # (half the control bytes); both ranks took that branch
+    assert trajs[0]["slot_steps"] > 0 and trajs[1]["slot_steps"] > 0
 
     ref, _ = _single_host_reference(rcv1_path, "", hash_capacity=0,
                                     V_dim=0)
