@@ -476,6 +476,34 @@ class SGDLearner(Learner):
         self._packed_panel_train_chunked = jax.jit(
             packed_panel_train_chunked, donate_argnums=0,
             static_argnums=(6, 7, 8, 9, 10, 11))
+
+        def packed_panel_train_chunked2(state, pa, pb, b_cap, width,
+                                        u_cap, has_cnt, binary,
+                                        has_remap=False):
+            # TWO cached batches in ONE dispatch (replay epochs only):
+            # on tunneled/remote devices each program invocation costs
+            # ~10 ms of host marshalling that a ~30-step replay epoch
+            # pays in full; pairing halves the invocation count.
+            # Straight-line composition, NOT lax.scan — the scan's
+            # loop-carry copies on the gather-then-scatter table were
+            # measured 55% slower at V64 (docs/perf_notes.md "scan
+            # replay"); unrolling keeps the donated in-place update.
+            state, o1, a1 = packed_panel_train_chunked(
+                state, *pa, b_cap, width, u_cap, has_cnt, binary,
+                has_remap)
+            state, o2, a2 = packed_panel_train_chunked(
+                state, *pb, b_cap, width, u_cap, has_cnt, binary,
+                has_remap)
+            return state, o1, a1, o2, a2
+
+        self._packed_panel_train_chunked2 = jax.jit(
+            packed_panel_train_chunked2, donate_argnums=0,
+            static_argnums=(3, 4, 5, 6, 7, 8))
+        # statics-key -> compiled pair executable (or None while the
+        # background compile runs / if it failed). Replay pairs ONLY
+        # when the executable is ready, so the ~18 s pair compile never
+        # lands on an epoch's critical path (_warm_pair_exec).
+        self._pair_execs: dict = {}
         # device-side zeroing of the packed f32 counts tail: replayed cache
         # entries must not re-push epoch-0 feature counts
         self._zero_counts = jax.jit(
@@ -1318,6 +1346,37 @@ class SGDLearner(Learner):
             }
         return out
 
+    def _warm_pair_exec(self, arrays, statics) -> None:
+        """Background-compile the two-batches-per-dispatch replay variant
+        (packed_panel_train_chunked2) for this payload shape. Launched
+        from the staging pass so the compile overlaps its streaming;
+        replay pairs only once the executable is ready, so the compile
+        never extends any epoch (a paired first call would cost ~18 s
+        in-line — measured, epoch 2 of the criteo V16 run)."""
+        key = statics
+        if key in self._pair_execs or self.mesh is not None:
+            return
+        self._pair_execs[key] = None  # claimed; ready when not None
+
+        def sds(x):
+            return None if x is None else jax.ShapeDtypeStruct(x.shape,
+                                                               x.dtype)
+
+        state_s = jax.tree_util.tree_map(sds, self.store.state)
+        pa = tuple(sds(t) for t in arrays)
+
+        def build():
+            try:
+                lowered = self._packed_panel_train_chunked2.lower(
+                    state_s, pa, pa, *key)
+                self._pair_execs[key] = lowered.compile()
+            except Exception as e:  # pragma: no cover - best-effort warm
+                log.warning("pair-replay precompile failed "
+                            "(replaying per-step): %s", e)
+
+        threading.Thread(target=build, name="pair-exec-compile",
+                         daemon=True).start()
+
     def _replay_cached(self, job_type: int, epoch: int,
                        cache: _DeviceBatchCache, prog: Progress) -> None:
         """Steady-state epoch: replay HBM-resident staged batches — zero
@@ -1335,20 +1394,64 @@ class SGDLearner(Learner):
         cur_part = 0
         reports = self._part_reports(job_type)
         before = Progress(nrows=prog.nrows, loss=prog.loss, auc=prog.auc)
+        # consecutive train batches with identical statics replay as
+        # PAIRS through one dispatch (packed_panel_train_chunked2);
+        # ``held`` is the batch awaiting a partner
+        held = None
+
+        def flush_held():
+            nonlocal held
+            if held is not None:
+                self._dispatch_packed(job_type, held, pending)
+                held = None
+
+        def dispatch_pair(a, b, exec_):
+            pa = (a[1], a[2], a[3], a[4], a[5])
+            pb = (b[1], b[2], b[3], b[4], b[5])
+            self.store.state, o1, a1, o2, a2 = exec_(
+                self.store.state, pa, pb)
+            pending.append((a[12], o1, a1))
+            pending.append((b[12], o2, a2))
+            self._paired_dispatches = getattr(
+                self, "_paired_dispatches", 0) + 1
         with guard:
             for part, payload in cache.iter_parts(
                     is_train and p.shuffle > 0, seed=epoch):
                 if reports and part != cur_part:
+                    flush_held()
                     self._merge_pending(pending, prog)
                     pending = []
                     self._report_part(job_type, before, prog)
                     before = Progress(nrows=prog.nrows, loss=prog.loss,
                                       auc=prog.auc)
                     cur_part = part
-                self._dispatch_packed(job_type, payload, pending)
+                exec_ = None
+                if is_train and payload[0] == "panel_chunked":
+                    key = payload[6:12]
+                    if key not in self._pair_execs:
+                        # cache staged before the warm hook existed for
+                        # this shape (e.g. a resumed process): compile in
+                        # the background, pair from the NEXT epoch on
+                        self._warm_pair_exec(payload[1:6], key)
+                    exec_ = self._pair_execs.get(key)
+                if exec_ is not None:
+                    if held is None:
+                        held = payload
+                    elif held[6:12] == payload[6:12]:
+                        a, held = held, None
+                        dispatch_pair(a, payload, exec_)
+                    else:
+                        # statics differ (e.g. a ragged-tail shape):
+                        # dispatch the held one alone, hold this one
+                        a, held = held, payload
+                        self._dispatch_packed(job_type, a, pending)
+                else:
+                    flush_held()
+                    self._dispatch_packed(job_type, payload, pending)
                 if len(pending) >= self._MERGE_CAP:
                     self._merge_pending(pending, prog)
                     pending = []
+            flush_held()
             if cache.partial:
                 # streamed parts follow this replay — the epoch-final
                 # (penalty, nnz) eval belongs to the epoch's END, not
@@ -1718,6 +1821,15 @@ class SGDLearner(Learner):
                           ("panel_chunked", i32, f32, ci, cl, cv, b_cap,
                            d2, u_cap, wc, binary, has_rm, blk.size),
                           nbytes, capacity=self.store.state.capacity)
+                # start the pair-replay compile while this staging pass
+                # still streams (it has ~30s of host/transfer time to
+                # hide the ~18s compile behind) — unless that add just
+                # froze or invalidated the cache, in which case no
+                # replay will ever use the executable
+                if cache.staging:
+                    self._warm_pair_exec((i32, f32, ci, cl, cv),
+                                         (b_cap, d2, u_cap, wc, binary,
+                                          has_rm))
             else:
                 cache.add(part,
                           (layout, i32, f32, b_cap, d2, u_cap, wc,
