@@ -397,47 +397,43 @@ class SGDLearner(Learner):
         # remote devices per-transfer latency dominates the host->device
         # path, so 2 transfers/batch instead of 8
         def packed_train(state, i32, f32, b_cap, nnz_cap, u_cap, has_cnt,
-                         binary, has_remap=False):
+                         binary):
             batch, slots, counts = unpack_batch(i32, f32, b_cap, nnz_cap,
-                                                u_cap, has_cnt, binary,
-                                                has_remap)
+                                                u_cap, has_cnt, binary)
             if counts is not None:
                 state = fns.apply_count(state, slots, counts)
             return train_step(state, batch, slots)
 
-        def packed_eval(state, i32, f32, b_cap, nnz_cap, u_cap, binary,
-                        has_remap=False):
+        def packed_eval(state, i32, f32, b_cap, nnz_cap, u_cap, binary):
             batch, slots, _ = unpack_batch(i32, f32, b_cap, nnz_cap, u_cap,
-                                           binary=binary,
-                                           has_remap=has_remap)
+                                           binary=binary)
             return eval_step(state, batch, slots)
 
         self._packed_train = jax.jit(packed_train, donate_argnums=0,
-                                     static_argnums=(3, 4, 5, 6, 7, 8))
+                                     static_argnums=(3, 4, 5, 6, 7))
         self._packed_eval = jax.jit(packed_eval,
-                                    static_argnums=(3, 4, 5, 6, 7))
+                                    static_argnums=(3, 4, 5, 6))
 
         from ..ops.batch import unpack_panel
 
         def packed_panel_train(state, i32, f32, b_cap, width, u_cap,
-                               has_cnt, binary, has_remap=False):
+                               has_cnt, binary):
             pb, slots, counts = unpack_panel(i32, f32, b_cap, width, u_cap,
-                                             has_cnt, binary, has_remap)
+                                             has_cnt, binary)
             if counts is not None:
                 state = fns.apply_count(state, slots, counts)
             return train_step(state, pb, slots)
 
-        def packed_panel_eval(state, i32, f32, b_cap, width, u_cap, binary,
-                              has_remap=False):
+        def packed_panel_eval(state, i32, f32, b_cap, width, u_cap, binary):
             pb, slots, _ = unpack_panel(i32, f32, b_cap, width, u_cap,
-                                        binary=binary, has_remap=has_remap)
+                                        binary=binary)
             return eval_step(state, pb, slots)
 
         self._packed_panel_train = jax.jit(packed_panel_train,
                                            donate_argnums=0,
-                                           static_argnums=(3, 4, 5, 6, 7, 8))
+                                           static_argnums=(3, 4, 5, 6, 7))
         self._packed_panel_eval = jax.jit(packed_panel_eval,
-                                          static_argnums=(3, 4, 5, 6, 7))
+                                          static_argnums=(3, 4, 5, 6))
 
         # chunked-run variant for cached replays: the backward's per-token
         # scatter becomes a dense chunk gather+reduce plus a ~U + B*F/L row
@@ -464,10 +460,9 @@ class SGDLearner(Learner):
                                            static_argnums=(2, 3, 4, 5))
 
         def packed_panel_train_chunked(state, i32, f32, ci, cl, cv, b_cap,
-                                       width, u_cap, has_cnt, binary,
-                                       has_remap=False):
+                                       width, u_cap, has_cnt, binary):
             pb, slots, counts = unpack_panel(i32, f32, b_cap, width, u_cap,
-                                             has_cnt, binary, has_remap)
+                                             has_cnt, binary)
             if counts is not None:
                 state = fns.apply_count(state, slots, counts)
             pb = pb._replace(chunk_idx=ci, chunk_lane=cl, chunk_vals=cv)
@@ -475,11 +470,10 @@ class SGDLearner(Learner):
 
         self._packed_panel_train_chunked = jax.jit(
             packed_panel_train_chunked, donate_argnums=0,
-            static_argnums=(6, 7, 8, 9, 10, 11))
+            static_argnums=(6, 7, 8, 9, 10))
 
         def packed_panel_train_chunked2(state, pa, pb, b_cap, width,
-                                        u_cap, has_cnt, binary,
-                                        has_remap=False):
+                                        u_cap, has_cnt, binary):
             # TWO cached batches in ONE dispatch (replay epochs only):
             # on tunneled/remote devices each program invocation costs
             # ~10 ms of host marshalling that a ~30-step replay epoch
@@ -489,16 +483,14 @@ class SGDLearner(Learner):
             # measured 55% slower at V64 (docs/perf_notes.md "scan
             # replay"); unrolling keeps the donated in-place update.
             state, o1, a1 = packed_panel_train_chunked(
-                state, *pa, b_cap, width, u_cap, has_cnt, binary,
-                has_remap)
+                state, *pa, b_cap, width, u_cap, has_cnt, binary)
             state, o2, a2 = packed_panel_train_chunked(
-                state, *pb, b_cap, width, u_cap, has_cnt, binary,
-                has_remap)
+                state, *pb, b_cap, width, u_cap, has_cnt, binary)
             return state, o1, a1, o2, a2
 
         self._packed_panel_train_chunked2 = jax.jit(
             packed_panel_train_chunked2, donate_argnums=0,
-            static_argnums=(3, 4, 5, 6, 7, 8))
+            static_argnums=(3, 4, 5, 6, 7))
         # statics-key -> compiled pair executable (or None while the
         # background compile runs / if it failed). Replay pairs ONLY
         # when the executable is ready, so the ~18 s pair compile never
@@ -1161,37 +1153,35 @@ class SGDLearner(Learner):
                                   stream_chunk=stream_chunk)
 
     def _pack_payload(self, cblk, n_lanes, padded, b_cap, dim_min: int,
-                      job: str, counts=None, remap=None,
+                      job: str, counts=None,
                       stream_chunk: bool = False):
         """Shared pack tail of all three batch-preparation paths
         (_prepare_hashed / _prepare_from_uniq / _pack_mapped): panel
         layout when rows are near-uniform, COO otherwise, shape caps
         from the sticky schedule. One definition, so the payload
-        contract (tuple order, has_rm flag, cap keys) can never diverge
-        between the producer-side and consumer-side packers. ``padded``
-        is the OOB-padded slot vector (its length IS u_cap); ``remap``
-        present => the step resolves in-batch collisions on device."""
+        contract (tuple order, cap keys) can never diverge between the
+        producer-side and consumer-side packers. ``padded`` is the
+        OOB-padded slot vector (its length IS u_cap); ``cblk.index``
+        must already address its sorted-unique lanes (host dedup)."""
         from ..ops.batch import pack_batch, pack_panel, panel_width
         u_cap = len(padded)
-        has_rm = remap is not None
         width = panel_width(cblk, b_cap)
         if width is not None:
             width = self._shapes.cap(job + ".w", width, exact=True)
             i32, f32, binary = pack_panel(
                 cblk, n_lanes, padded, b_cap, width, u_cap,
-                counts=counts, remap=remap)
+                counts=counts)
             if stream_chunk:
                 return ("panel_chunked", i32, f32,
                         self._chunk_host(i32, f32, b_cap, width, u_cap,
                                          binary),
-                        binary, b_cap, width, u_cap, has_rm)
-            return ("panel", i32, f32, binary, b_cap, width, u_cap,
-                    has_rm)
+                        binary, b_cap, width, u_cap)
+            return ("panel", i32, f32, binary, b_cap, width, u_cap)
         nnz_cap = self._shapes.cap(job + ".nnz", cblk.nnz, dim_min)
         i32, f32, binary = pack_batch(
             cblk, n_lanes, padded, b_cap, nnz_cap, u_cap,
-            counts=counts, remap=remap)
-        return ("coo", i32, f32, binary, b_cap, nnz_cap, u_cap, has_rm)
+            counts=counts)
+        return ("coo", i32, f32, binary, b_cap, nnz_cap, u_cap)
 
     def _chunk_host(self, i32: np.ndarray, f32: np.ndarray, b_cap: int,
                     width: int, u_cap: int, binary: bool):
@@ -1212,17 +1202,25 @@ class SGDLearner(Learner):
                            b_cap: Optional[int] = None,
                            stream_chunk: bool = False):
         """Cached fast path (data/cached.py): the block arrives already
-        localized to ``uniq`` (sorted reversed ids), so host work is just
-        the O(uniq) slot map + dedup; the O(nnz) index array ships
-        UNTOUCHED — in-batch hash collisions ride the packed ``remap``
-        vector and are resolved on device (step.py pull/push_grads).
+        localized to ``uniq`` (sorted reversed ids). The slot map + dedup
+        is O(uniq); the O(nnz) index gather through the uniq->slot
+        permutation runs HERE, once, on the producer thread. The payload
+        used to ship that permutation to the device instead ("the index
+        array ships untouched") — but resolving it per step cost an
+        unsorted u_cap-row permute on pull plus a scatter-add on push,
+        measured as the whole gap between hashed and dictionary replay
+        (2.57 vs 2.18 s steady epochs on the same data,
+        docs/perf_notes.md round-5 "host dedup"); a staged batch pays the
+        host gather once and replays the clean layout every epoch.
         Shape caps come from the sticky schedule; the counts section stays
         present all run (see _prepare_hashed)."""
         from ..store.local import hash_slots, pad_slots_oob
 
         raw = hash_slots(uniq, self.store.param.hash_capacity)
         slots, remap = np.unique(raw, return_inverse=True)
-        n_lanes = len(uniq)
+        cblk = dataclasses.replace(
+            cblk, index=remap[cblk.index].astype(np.uint32))
+        n_lanes = len(slots)
         u_cap = self._shapes.cap(job + ".u", n_lanes)
         b_cap = b_cap or self._shapes.cap(job + ".b", cblk.size, dim_min)
         scounts = np.zeros(0, np.float32) if want_counts else None
@@ -1230,15 +1228,12 @@ class SGDLearner(Learner):
             # counts are per uniq lane; aggregate to slot space (colliding
             # lanes sum, mirroring map_keys_dedup)
             scounts = np.zeros(u_cap, dtype=np.float32)
-            scounts[:len(slots)] = np.bincount(
-                remap, weights=counts, minlength=len(slots))
+            scounts[:n_lanes] = np.bincount(
+                remap, weights=counts, minlength=n_lanes)
         padded = pad_slots_oob(slots.astype(np.int32), u_cap,
                                self.store.param.hash_capacity)
-        # chunk lanes (stream_chunk) live in uniq-lane space; the step's
-        # remap permutation (pull/push_grads) applies unchanged
         return self._pack_payload(cblk, n_lanes, padded, b_cap, dim_min,
                                   job, counts=scounts,
-                                  remap=remap.astype(np.int32),
                                   stream_chunk=stream_chunk)
 
     def _cached_uri(self, job_type: int) -> Optional[str]:
@@ -1410,8 +1405,8 @@ class SGDLearner(Learner):
             pb = (b[1], b[2], b[3], b[4], b[5])
             self.store.state, o1, a1, o2, a2 = exec_(
                 self.store.state, pa, pb)
-            pending.append((a[12], o1, a1))
-            pending.append((b[12], o2, a2))
+            pending.append((a[11], o1, a1))
+            pending.append((b[11], o2, a2))
             self._paired_dispatches = getattr(
                 self, "_paired_dispatches", 0) + 1
         with guard:
@@ -1427,7 +1422,7 @@ class SGDLearner(Learner):
                     cur_part = part
                 exec_ = None
                 if is_train and payload[0] == "panel_chunked":
-                    key = payload[6:12]
+                    key = payload[6:11]
                     if key not in self._pair_execs:
                         # cache staged before the warm hook existed for
                         # this shape (e.g. a resumed process): compile in
@@ -1437,7 +1432,7 @@ class SGDLearner(Learner):
                 if exec_ is not None:
                     if held is None:
                         held = payload
-                    elif held[6:12] == payload[6:12]:
+                    elif held[6:11] == payload[6:11]:
                         a, held = held, None
                         dispatch_pair(a, payload, exec_)
                     else:
@@ -1604,7 +1599,7 @@ class SGDLearner(Learner):
                          label=None) -> None:
         """Run the fused step on an already-staged packed batch. ``payload``
         = (layout, i32_dev, f32_dev, b_cap, dim2, u_cap, want_counts,
-        binary, has_rm, nrows); dim2 is the panel width or the COO nnz_cap."""
+        binary, nrows); dim2 is the panel width or the COO nnz_cap."""
         is_train = job_type == K_TRAINING
         if payload[0] == "devbatch":
             # cached replay of a staged mesh/multi-host global batch
@@ -1621,32 +1616,30 @@ class SGDLearner(Learner):
             # cached replay fast path (train only): packed panel + the
             # staged chunked-run backward layout
             (_, i32, f32, ci, cl, cv, b_cap, d2, u_cap, want_counts,
-             binary, has_rm, nrows) = payload
+             binary, nrows) = payload
             self.store.state, objv, auc = self._packed_panel_train_chunked(
                 self.store.state, i32, f32, ci, cl, cv, b_cap, d2, u_cap,
-                want_counts, binary, has_rm)
+                want_counts, binary)
             pending.append((nrows, objv, auc))
             return
-        (layout, i32, f32, b_cap, d2, u_cap, want_counts, binary, has_rm,
+        (layout, i32, f32, b_cap, d2, u_cap, want_counts, binary,
          nrows) = payload
         if layout == "panel":
             if is_train:
                 self.store.state, objv, auc = self._packed_panel_train(
                     self.store.state, i32, f32, b_cap, d2, u_cap,
-                    want_counts, binary, has_rm)
+                    want_counts, binary)
             else:
                 pred, objv, auc = self._packed_panel_eval(
-                    self.store.state, i32, f32, b_cap, d2, u_cap, binary,
-                    has_rm)
+                    self.store.state, i32, f32, b_cap, d2, u_cap, binary)
         else:
             if is_train:
                 self.store.state, objv, auc = self._packed_train(
                     self.store.state, i32, f32, b_cap, d2, u_cap,
-                    want_counts, binary, has_rm)
+                    want_counts, binary)
             else:
                 pred, objv, auc = self._packed_eval(
-                    self.store.state, i32, f32, b_cap, d2, u_cap, binary,
-                    has_rm)
+                    self.store.state, i32, f32, b_cap, d2, u_cap, binary)
         if job_type == K_PREDICTION and self.param.pred_out:
             self._save_pred(np.asarray(pred)[:nrows], label)
         pending.append((nrows, objv, auc))
@@ -1742,8 +1735,9 @@ class SGDLearner(Learner):
         map_keys mutates host state) — the same panel/COO layouts
         _prepare_hashed builds on producer threads, so both store modes
         dispatch the identical prepared path. ``slots_np`` is sorted
-        unique (map_keys_dedup contract); no remap section is needed —
-        the dictionary never aliases distinct ids."""
+        unique (map_keys_dedup contract), and ``cblk.index`` already
+        addresses its lanes — the dictionary never aliases distinct
+        ids."""
         from ..store.local import pad_slots_oob
         n_uniq = len(slots_np)
         u_cap = self._shapes.cap(job + ".u", n_uniq)
@@ -1774,14 +1768,14 @@ class SGDLearner(Learner):
             # sort already ran on the producer thread, so both
             # streamed dispatch AND cache staging use these chunks
             (_, i32, f32, (ci_np, cl_np, cv_np), binary, b_cap, d2,
-             u_cap, has_rm) = payload
+             u_cap) = payload
             layout = "panel"
             i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
             ci, cl = jnp.asarray(ci_np), jnp.asarray(cl_np)
             cv = None if cv_np is None else jnp.asarray(cv_np)
             chunked = True
         else:
-            layout, i32, f32, binary, b_cap, d2, u_cap, has_rm = payload
+            layout, i32, f32, binary, b_cap, d2, u_cap = payload
             i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
             chunked = False
         wc = want_counts if is_train else False
@@ -1798,10 +1792,10 @@ class SGDLearner(Learner):
             chunked = True
         if chunked:
             dev_payload = ("panel_chunked", i32, f32, ci, cl, cv, b_cap,
-                           d2, u_cap, wc, binary, has_rm, blk.size)
+                           d2, u_cap, wc, binary, blk.size)
         else:
             dev_payload = (layout, i32, f32, b_cap, d2, u_cap, wc,
-                           binary, has_rm, blk.size)
+                           binary, blk.size)
         self._dispatch_packed(job_type, dev_payload, pending,
                               label=blk.label)
         if cache is not None and cache.staging:
@@ -1819,7 +1813,7 @@ class SGDLearner(Learner):
                     0 if cv is None else cv.nbytes)
                 cache.add(part,
                           ("panel_chunked", i32, f32, ci, cl, cv, b_cap,
-                           d2, u_cap, wc, binary, has_rm, blk.size),
+                           d2, u_cap, wc, binary, blk.size),
                           nbytes, capacity=self.store.state.capacity)
                 # start the pair-replay compile while this staging pass
                 # still streams (it has ~30s of host/transfer time to
@@ -1828,12 +1822,11 @@ class SGDLearner(Learner):
                 # replay will ever use the executable
                 if cache.staging:
                     self._warm_pair_exec((i32, f32, ci, cl, cv),
-                                         (b_cap, d2, u_cap, wc, binary,
-                                          has_rm))
+                                         (b_cap, d2, u_cap, wc, binary))
             else:
                 cache.add(part,
                           (layout, i32, f32, b_cap, d2, u_cap, wc,
-                           binary, has_rm, blk.size),
+                           binary, blk.size),
                           nbytes, capacity=self.store.state.capacity)
 
     def _panel_host_batch(self, cblk, n_uniq: int, b_cap: int, width: int,

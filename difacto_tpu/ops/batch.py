@@ -32,12 +32,13 @@ from ..data.rowblock import RowBlock
 class DeviceBatch(NamedTuple):
     """Padded COO batch; all leaves are jnp arrays, shapes static per bucket.
 
-    ``remap`` (optional int32[u_cap]) declares that ``cols`` address a
-    *uniq-lane* space whose lane i corresponds to row ``remap[i]`` of the
-    batch's sorted-unique slot vector — the device-side form of the host's
-    collision dedup (store.map_keys_dedup): the step gathers parameter rows
-    through it and scatter-adds gradients back, so the host never rewrites
-    the O(nnz) index array. None = cols already address slot rows directly.
+    ``cols`` address the batch's sorted-unique slot vector directly: every
+    producer resolves in-batch collisions on the HOST (store.map_keys_dedup
+    or the producer-thread np.unique), rewriting the O(nnz) index array
+    once per batch. A device-side remap permutation used to carry this for
+    the cached reader; it cost an unsorted u_cap-row permute + scatter-add
+    per step — more than the host gather it saved (docs/perf_notes.md,
+    round-5 "host dedup").
     """
     rows: jnp.ndarray      # int32[NNZ] row of each nonzero (pad: last real row)
     cols: jnp.ndarray      # int32[U-index] of each nonzero (pad: 0)
@@ -47,7 +48,6 @@ class DeviceBatch(NamedTuple):
     row_mask: jnp.ndarray  # f32[B] 1 for real rows
     num_rows: jnp.ndarray  # i32[] actual batch size
     num_uniq: jnp.ndarray  # i32[] actual distinct-feature count
-    remap: Optional[jnp.ndarray] = None  # i32[u_cap] uniq-lane -> slot row
 
     @property
     def batch_cap(self) -> int:
@@ -75,7 +75,6 @@ class PanelBatch(NamedTuple):
     row_mask: jnp.ndarray  # f32[B] 1 for real rows
     num_rows: jnp.ndarray  # i32[]
     num_uniq: jnp.ndarray  # i32[]
-    remap: Optional[jnp.ndarray] = None  # i32[u_cap]; see DeviceBatch.remap
     # chunked-run layout (panel_chunk_tokens): the fastest backward. Each
     # lane's token run is padded into fixed-L gather chunks; the per-token
     # sorted scatter (a serial ~10 ns/row update loop, half the fused step
@@ -157,21 +156,15 @@ def pad_panel(blk: RowBlock, num_uniq: int, batch_cap: int, width: int
     )
 
 
-def identity_remap(u_cap: int) -> np.ndarray:
-    return np.arange(u_cap, dtype=np.int32)
-
-
 def pack_panel(blk: RowBlock, num_uniq: int, slots: np.ndarray,
                batch_cap: int, width: int, u_cap: int,
-               counts: Optional[np.ndarray] = None,
-               remap: Optional[np.ndarray] = None):
+               counts: Optional[np.ndarray] = None):
     """Panel equivalent of pack_batch: TWO host buffers per batch.
 
-    i32 = [idx(B*F) | slots(u_cap, pre-padded via pad_slots_oob) |
-    remap(u_cap) | b, nu];
+    i32 = [idx(B*F) | slots(u_cap, pre-padded via pad_slots_oob) | b, nu];
     f32 = [vals(B*F)? | labels(B) | rweight(B) | row_mask(B) | counts(u)?].
-    ``remap`` is the uniq-lane -> slot-row map (None = identity: idx
-    addresses slot rows directly); see DeviceBatch.remap.
+    ``idx`` addresses slot rows directly (collision dedup happens on the
+    host before packing).
     """
     if len(slots) != u_cap:
         raise ValueError(f"slots must arrive pre-padded to u_cap={u_cap}")
@@ -179,15 +172,10 @@ def pack_panel(blk: RowBlock, num_uniq: int, slots: np.ndarray,
                                                          width)
     binary = vals is None
     cells = batch_cap * width
-    i32 = np.empty(cells + 2 * u_cap + 2, dtype=np.int32)
+    i32 = np.empty(cells + u_cap + 2, dtype=np.int32)
     i32[:cells] = idx.reshape(-1)
     i32[cells:cells + u_cap] = slots
-    if remap is None:
-        i32[cells + u_cap:cells + 2 * u_cap] = identity_remap(u_cap)
-    else:
-        i32[cells + u_cap:cells + u_cap + len(remap)] = remap
-        i32[cells + u_cap + len(remap):cells + 2 * u_cap] = 0
-    i32[cells + 2 * u_cap:] = (blk.size, num_uniq)
+    i32[cells + u_cap:] = (blk.size, num_uniq)
     vals_n = 0 if binary else cells
     nf32 = vals_n + 3 * batch_cap + (u_cap if counts is not None else 0)
     f32 = np.zeros(max(nf32, 1), dtype=REAL_DTYPE)
@@ -207,17 +195,13 @@ def pack_panel(blk: RowBlock, num_uniq: int, slots: np.ndarray,
 
 
 def unpack_panel(i32, f32, batch_cap: int, width: int, u_cap: int,
-                 has_counts: bool = False, binary: bool = False,
-                 has_remap: bool = False):
+                 has_counts: bool = False, binary: bool = False):
     """jit-traceable inverse of pack_panel ->
-    (PanelBatch, slots, counts-or-None). ``has_remap`` (static) exposes the
-    remap section to the step; False leaves pb.remap None so legacy callers
-    pay no permutation."""
+    (PanelBatch, slots, counts-or-None)."""
     cells = batch_cap * width
     idx = i32[:cells].reshape(batch_cap, width)
     slots = i32[cells:cells + u_cap]
-    remap = i32[cells + u_cap:cells + 2 * u_cap] if has_remap else None
-    meta = i32[cells + 2 * u_cap:]
+    meta = i32[cells + u_cap:]
     o = 0
     vals = None
     if not binary:
@@ -231,8 +215,7 @@ def unpack_panel(i32, f32, batch_cap: int, width: int, u_cap: int,
     o += batch_cap
     counts = f32[o:o + u_cap] if has_counts else None
     pb = PanelBatch(idx=idx, vals=vals, labels=labels, rweight=rweight,
-                    row_mask=row_mask, num_rows=meta[0], num_uniq=meta[1],
-                    remap=remap)
+                    row_mask=row_mask, num_rows=meta[0], num_uniq=meta[1])
     return pb, slots, counts
 
 
@@ -390,17 +373,16 @@ def mesh_dim_min(dp: int, floor: int = 8) -> int:
 
 def pack_batch(blk: RowBlock, num_uniq: int, slots: np.ndarray,
                batch_cap: int, nnz_cap: int, u_cap: int,
-               counts: Optional[np.ndarray] = None,
-               remap: Optional[np.ndarray] = None):
+               counts: Optional[np.ndarray] = None):
     """Pack a localized block + slot vector into TWO host buffers
     (int32 + float32) so staging costs two device transfers instead of
     eight — on tunneled/remote devices per-transfer latency dominates.
 
-    Layout (static per bucket): i32 = [rows(nnz) | cols(nnz) | slots(u) |
-    remap(u)]; f32 = [vals(nnz)? | labels(B) | rweight(B) | row_mask(B) |
+    Layout (static per bucket): i32 = [rows(nnz) | cols(nnz) | slots(u)];
+    f32 = [vals(nnz)? | labels(B) | rweight(B) | row_mask(B) |
     counts(u)?]. Binary blocks (value is None — e.g. criteo) omit the vals
     section and reconstruct ones*row-validity on device, halving the f32
-    payload. ``remap``: see DeviceBatch.remap (None = identity).
+    payload. ``cols`` address slot rows directly (host-side dedup).
     ``unpack_batch`` is the jit-side inverse.
     """
     b, nnz = blk.size, blk.nnz
@@ -417,17 +399,12 @@ def pack_batch(blk: RowBlock, num_uniq: int, slots: np.ndarray,
     binary = blk.value is None
     # trailing 3 ints: [b, num_uniq, nnz] — kept in the i32 buffer so they
     # stay exact (f32 would round past 2^24)
-    i32 = np.zeros(2 * nnz_cap + 2 * u_cap + 3, dtype=np.int32)
+    i32 = np.zeros(2 * nnz_cap + u_cap + 3, dtype=np.int32)
     i32[:nnz] = blk.row_ids()
     i32[nnz:nnz_cap] = max(b - 1, 0)  # pad rows -> a real segment, vals 0
     i32[nnz_cap:nnz_cap + nnz] = blk.index.astype(np.int32)
     i32[2 * nnz_cap:2 * nnz_cap + u_cap] = slots
-    ro = 2 * nnz_cap + u_cap
-    if remap is None:
-        i32[ro:ro + u_cap] = identity_remap(u_cap)
-    else:
-        i32[ro:ro + len(remap)] = remap
-    i32[2 * nnz_cap + 2 * u_cap:] = (b, num_uniq, nnz)
+    i32[2 * nnz_cap + u_cap:] = (b, num_uniq, nnz)
 
     vals_n = 0 if binary else nnz_cap
     nf32 = vals_n + 3 * batch_cap \
@@ -449,8 +426,7 @@ def pack_batch(blk: RowBlock, num_uniq: int, slots: np.ndarray,
 
 
 def unpack_batch(i32, f32, batch_cap: int, nnz_cap: int, u_cap: int,
-                 has_counts: bool = False, binary: bool = False,
-                 has_remap: bool = False):
+                 has_counts: bool = False, binary: bool = False):
     """jit-traceable inverse of pack_batch ->
     (DeviceBatch, slots, counts-or-None)."""
     import jax.numpy as jnp
@@ -458,9 +434,7 @@ def unpack_batch(i32, f32, batch_cap: int, nnz_cap: int, u_cap: int,
     rows = i32[:nnz_cap]
     cols = i32[nnz_cap:2 * nnz_cap]
     slots = i32[2 * nnz_cap:2 * nnz_cap + u_cap]
-    ro = 2 * nnz_cap + u_cap
-    remap = i32[ro:ro + u_cap] if has_remap else None
-    meta = i32[ro + u_cap:]  # [b, num_uniq, nnz], exact int32
+    meta = i32[2 * nnz_cap + u_cap:]  # [b, num_uniq, nnz], exact int32
     if binary:
         # all-ones values, zeroed on padding entries (value elision,
         # src/reader/batch_reader.cc:71-73 carried to the device side)
@@ -484,7 +458,6 @@ def unpack_batch(i32, f32, batch_cap: int, nnz_cap: int, u_cap: int,
         row_mask=row_mask,
         num_rows=meta[0],
         num_uniq=meta[1],
-        remap=remap,
     )
     return batch, slots, counts
 

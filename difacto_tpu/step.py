@@ -7,11 +7,12 @@ update ("Push"). The learner (learners/sgd.py), the driver entry
 (__graft_entry__.py) and the benchmark (bench.py) all build their steps here
 so they can never drift apart.
 
-``batch.remap`` (ops/batch.py) lets the batch address a *uniq-lane* space:
-the step permutes the pulled slot rows out to uniq lanes before the loss and
-scatter-adds the uniq-space gradients back to slot rows before the update —
-the device-side form of the host's collision dedup (store.map_keys_dedup),
-so cached batches ship their index arrays untouched.
+Batches address the sorted-unique slot vector directly: in-batch collision
+dedup happens on the HOST (store.map_keys_dedup / the producer-thread
+np.unique), which rewrites the O(nnz) index array once per batch. The
+device-side remap permutation that used to carry this for the cached
+reader cost an unsorted u_cap-row permute + scatter-add per step — more
+than the host gather it saved (docs/perf_notes.md, round-5 "host dedup").
 
 ``train_auc`` picks the per-step training metric: "binned" (default) is the
 O(B) histogram AUC — the sort-based exact AUC costs ~10 ms at 64k batches,
@@ -39,26 +40,7 @@ def make_step_fns(fns, loss: LossSpec, train_auc: str = "binned") -> Tuple:
 
     def pull(state, batch, slots):
         w, V, vmask = fns.get_rows(state, slots)
-        slot_vmask = vmask
-        if batch.remap is not None:
-            w = w[batch.remap]
-            if V is not None:
-                V = V[batch.remap]
-                vmask = vmask[batch.remap]
-        return FMParams(w=w, V=V, v_mask=vmask), slot_vmask
-
-    def push_grads(batch, slots, gw, gV):
-        """Gradients back to slot space: colliding uniq lanes sum into their
-        shared slot row (the aliasing semantics of map_keys_dedup)."""
-        if batch.remap is None:
-            return gw, gV
-        u_cap = slots.shape[0]
-        gw_s = jnp.zeros((u_cap,), gw.dtype).at[batch.remap].add(gw)
-        gV_s = None
-        if gV is not None:
-            gV_s = jnp.zeros((u_cap,) + gV.shape[1:],
-                             gV.dtype).at[batch.remap].add(gV)
-        return gw_s, gV_s
+        return FMParams(w=w, V=V, v_mask=vmask), vmask
 
     def forward(state, batch, slots):
         params, _ = pull(state, batch, slots)
@@ -81,7 +63,6 @@ def make_step_fns(fns, loss: LossSpec, train_auc: str = "binned") -> Tuple:
         else:
             auc = jnp.float32(0.0)
         gw, gV = loss.calc_grad(params, batch, pred, xv)
-        gw, gV = push_grads(batch, slots, gw, gV)
         state = fns.apply_grad(state, slots, gw, gV, slot_vmask)
         return state, objv, auc
 

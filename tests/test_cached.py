@@ -1,13 +1,14 @@
 """Round-3 fast-path tests (round-3 verdict #2): the pre-localized rec
-cache (data/cached.py), the device-side collision remap it feeds
-(step.py pull/push_grads via DeviceBatch.remap), and the producer pool's
-failure path (data/producer_pool.py).
+cache (data/cached.py), its producer-thread collision dedup
+(learners/sgd.py _prepare_from_uniq — the uniq->slot gather that
+replaced the per-step device remap, docs/perf_notes.md round-5 "host
+dedup"), and the producer pool's failure path (data/producer_pool.py).
 
 The parity tests assert the cache reproduces the LIBSVM trajectory exactly
 (same hyperparameters, shuffle off): the cached path must be a faster
 encoding of the same computation, not a different one — including under
-heavy hash collisions, where the host path resolves aliasing via
-map_keys_dedup and the cached path via the packed device remap.
+heavy hash collisions, where both paths resolve aliasing through the
+same host-side segment-sum semantics (map_keys_dedup / np.unique).
 """
 
 from collections import defaultdict
@@ -69,8 +70,8 @@ def test_cache_is_localized(rcv1_rec):
 
 
 def test_cached_parity_whole_member(rcv1_rec_aligned, rcv1_path):
-    """Batch-aligned members (rec_batch_size=batch_size): each batch ships
-    its member's uniq untouched through the device remap path."""
+    """Batch-aligned members (rec_batch_size=batch_size): each batch maps
+    its member's uniq straight to slots on the producer thread."""
     ref, _ = run_trajectory(rcv1_path, "libsvm", 1 << 14)
     got, _ = run_trajectory(rcv1_rec_aligned, "rec", 1 << 14)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
@@ -88,8 +89,9 @@ def test_cached_parity_sliced_member(rcv1_rec, rcv1_path):
 def test_cached_parity_heavy_collisions(rcv1_rec, rcv1_path):
     """Tiny hash_capacity: distinct ids collide into shared slots within
     every batch. The host path merges them in map_keys_dedup; the cached
-    path must reach the same trajectory through the packed remap vector
-    (step.py pull gathers through it, push_grads scatter-adds back)."""
+    path must reach the same trajectory through the producer-thread
+    uniq->slot index gather (colliding lanes alias the same slot row, so
+    their gradients segment-sum together on device)."""
     ref, learner_ref = run_trajectory(rcv1_path, "libsvm", 61)
     got, learner_got = run_trajectory(rcv1_rec, "rec", 61)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)

@@ -90,7 +90,7 @@ def main() -> None:
                 sub, uniq, cnts, True, True, 8, "train",
                 b_cap_train))
             n_items += 1
-            layout, i32, f32, binary, b_cap, d2, u_cap, has_rm = payload
+            layout, i32, f32, binary, b_cap, d2, u_cap = payload
             payload_bytes += i32.nbytes + f32.nbytes
             if len(payloads) < 4:
                 payloads.append((i32, f32))
