@@ -515,6 +515,7 @@ class SGDLearner(Learner):
         # sgd_updater.h:141-147) and prints the throttled row
         from ..utils.reporter import Reporter
         self._last_nnz = 0.0
+        self._last_row_t = time.monotonic()
         self.reporter = Reporter(every=1)
         self.reporter.set_monitor(self._on_report)
         pre_loss, pre_val_auc = 0.0, 0.0
@@ -671,12 +672,17 @@ class SGDLearner(Learner):
                     if not cache.partial:
                         return
                     cached_parts = cache.parts()
+            before = Progress(nrows=prog.nrows, loss=prog.loss,
+                              auc=prog.auc)
             for part in range(n_jobs):
                 if part in cached_parts:
                     continue
-                before = Progress(nrows=prog.nrows, loss=prog.loss,
-                                  auc=prog.auc)
                 self._iterate_data_spmd(job_type, epoch, part, n_jobs, prog)
+                if self._row_due(job_type):
+                    self._report_part(job_type, before, prog)
+                    before = Progress(nrows=prog.nrows, loss=prog.loss,
+                                      auc=prog.auc)
+            if prog.nrows > before.nrows:
                 self._report_part(job_type, before, prog)
             # a full pass completed: the dictionary now holds every id of
             # this job's data, so later streamed passes exchange slots
@@ -696,6 +702,22 @@ class SGDLearner(Learner):
         the epoch-final stack stays bounded."""
         return job_type == K_TRAINING and self.param.report_interval > 0
 
+    def _row_due(self, job_type: int) -> bool:
+        """TIME-throttled part-boundary rows: a part boundary emits a row
+        only when ``report_interval`` seconds have elapsed since the last
+        one (the reference prints on a time interval too,
+        sgd_learner.cc:242-247; here boundaries are the only candidate
+        sites, so the cadence floor is one row per part). The throttle
+        matters because a part-boundary row costs a SYNCHRONOUS device
+        fetch (the pending metric merge plus the monitor's nnz(w)
+        evaluate) and, on the replay path, flushes the held pair — at the
+        default interval the §4 replay epoch measured 5.25 s with a row
+        per part vs 2.12 s with rows only when due (docs/perf_notes.md
+        round-5)."""
+        return (self._part_reports(job_type)
+                and time.monotonic() - self._last_row_t
+                >= self.param.report_interval)
+
     # max dispatched-batch metrics held before a merge when per-part
     # reporting is off: bounds the epoch-final jnp.stack operand count
     # (and the live tiny device buffers) while amortizing the fetch RTT
@@ -708,6 +730,7 @@ class SGDLearner(Learner):
         per-batch reporter messages (sgd_learner.cc:242-247)."""
         if not self._part_reports(job_type):
             return
+        self._last_row_t = time.monotonic()
         self.reporter.report(Progress(
             nrows=prog.nrows - before.nrows,
             loss=prog.loss - before.loss,
@@ -1413,13 +1436,14 @@ class SGDLearner(Learner):
             for part, payload in cache.iter_parts(
                     is_train and p.shuffle > 0, seed=epoch):
                 if reports and part != cur_part:
-                    flush_held()
-                    self._merge_pending(pending, prog)
-                    pending = []
-                    self._report_part(job_type, before, prog)
-                    before = Progress(nrows=prog.nrows, loss=prog.loss,
-                                      auc=prog.auc)
                     cur_part = part
+                    if self._row_due(job_type):
+                        flush_held()
+                        self._merge_pending(pending, prog)
+                        pending = []
+                        self._report_part(job_type, before, prog)
+                        before = Progress(nrows=prog.nrows, loss=prog.loss,
+                                          auc=prog.auc)
                 exec_ = None
                 if is_train and payload[0] == "panel_chunked":
                     key = payload[6:11]
@@ -1578,13 +1602,13 @@ class SGDLearner(Learner):
         for i, item in pool:
             part = stream_parts[i]
             if part != cur_part:
-                if reports:
+                cur_part = part
+                if reports and self._row_due(job_type):
                     self._merge_pending(pending, prog)
                     pending = []
                     self._report_part(job_type, before, prog)
                     before = Progress(nrows=prog.nrows, loss=prog.loss,
                                       auc=prog.auc)
-                cur_part = part
             self._dispatch_item(job_type, item, push_cnt, want_counts, job,
                                 dim_min, pending, cache=cache, part=cur_part)
             if len(pending) >= self._MERGE_CAP:
