@@ -52,8 +52,8 @@ def build_step(V_dim: int, capacity: int, v_dtype: str,
         loss = dataclasses.replace(loss, chunks_sorted=False)
     state = init_state(param, capacity)
     if V_dim:
-        import jax.numpy as jnp
-        state = state._replace(v_live=jnp.ones(capacity, dtype=bool))
+        from difacto_tpu.updaters.sgd_updater import set_all_live
+        state = set_all_live(param, state)
 
     _, train_step, _ = make_step_fns(fns, loss)
     # raw (unjitted) step: the bench jits it with a donated state and
@@ -140,7 +140,11 @@ def roofline(nnz: int, u_cap: int, V_dim: int, v_bytes: int,
     2*V_dim)."""
     if not vvg_cols:
         vvg_cols = 2 * V_dim
-    table = u_cap * (vvg_cols * v_bytes * 2 + 3 * 4 * 2)  # VVg g+s, scalars
+    # fused-row g+s: the row carries V, Vg AND the FTRL scalar lanes
+    # (updaters/sgd_updater.py row_layout), so there is no separate
+    # scalar-table term; V_dim=0 keeps the flat f32 w/z/sqrt_g arrays
+    table = (u_cap * vvg_cols * v_bytes * 2 if V_dim
+             else u_cap * 3 * 4 * 2)
     tokens = (nnz * (V_dim + 1) * v_bytes      # fwd [w|V] token gather
               + nnz * (V_dim + 1) * 4 * 2      # bwd f32 contribs (chunk
                                                # gather + partial reduce)

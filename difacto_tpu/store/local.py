@@ -314,12 +314,16 @@ class SlotStore:
         each host (dp replicates across hosts), so every piece is locally
         addressable."""
         from ..parallel.multihost import to_local_numpy
-        d = {f: to_local_numpy(a) for f, a in zip(SGDState._fields, state)}
+        from ..updaters.sgd_updater import col_V, col_Vg, scal_cols
+        w, zz, sg, cnt, live = scal_cols(self.param, state)
+        cols = {"w": w, "z": zz, "sqrt_g": sg, "cnt": cnt, "v_live": live,
+                "V": col_V(self.param, state),
+                "Vg": col_Vg(self.param, state)}
+        d = {f: to_local_numpy(a) for f, a in cols.items()}
         # bf16 storage (V_dtype) becomes float32 on the host: numpy/npz
         # have no bfloat16
-        vv = d.pop("VVg").astype(np.float32)
-        k, h = self.param.V_dim, vv.shape[1] // 2
-        d["V"], d["Vg"] = vv[:, :k], vv[:, h:h + k]
+        d["V"] = d["V"].astype(np.float32)
+        d["Vg"] = d["Vg"].astype(np.float32)
         return d
 
     def _assemble_state(self, arr: dict, capacity: int) -> SGDState:
@@ -330,7 +334,7 @@ class SlotStore:
         not the artifact's row count (a partial/sharded save with fewer
         rows would otherwise silently re-enable padding on a table that
         runs unpadded for memory reasons, round-4 advisor finding)."""
-        from ..updaters.sgd_updater import fuse_vvg, v_dtype, v_half
+        from ..updaters.sgd_updater import build_rows
         V = np.asarray(arr.pop("V"), dtype=np.float32)
         Vg = np.asarray(arr.pop("Vg"), dtype=np.float32)
         if V.shape[0] != capacity:
@@ -338,9 +342,15 @@ class SlotStore:
                 f"checkpoint arrays have {V.shape[0]} rows but the table "
                 f"capacity is {capacity}: partial-state loads are not "
                 "supported (the v_half layout decision would diverge)")
-        vvg = fuse_vvg(V, Vg, v_half(self.param, capacity))
-        return SGDState(VVg=vvg.astype(v_dtype(self.param)),
-                        **{f: jnp.asarray(a) for f, a in arr.items()})
+        if self.param.V_dim == 0:
+            return SGDState(VVg=jnp.zeros((capacity, 0), jnp.float32),
+                            **{f: jnp.asarray(a) for f, a in arr.items()})
+        T = build_rows(self.param, capacity, V, Vg, arr["w"], arr["z"],
+                       arr["sqrt_g"], arr["cnt"], arr["v_live"])
+        empty = jnp.zeros(0, jnp.float32)
+        return SGDState(w=empty, z=empty + 0, sqrt_g=empty + 0,
+                        cnt=empty + 0, VVg=T,
+                        v_live=jnp.zeros(0, dtype=bool))
 
     def save(self, path: str, save_aux: bool = False) -> int:
         """Checkpoint non-empty entries, sorted by key. Hashed mode has no
@@ -438,18 +448,17 @@ class SlotStore:
         entries. need_reverse un-reverses ids back to the original space.
         Hashed mode has no id dictionary: the first column is the slot id
         and need_reverse is ignored."""
+        st = self._state_np(self.state)
         if self.hashed:
-            w = np.asarray(self.state.w)
-            keep = w != 0
+            keep = st["w"] != 0
             if self.param.V_dim > 0:  # keep l1-shrunk rows with live V
-                keep |= np.asarray(self.state.v_live)
+                keep |= st["v_live"]
             keep[TRASH_SLOT] = False
             slots = np.nonzero(keep)[0]
             keys = slots.astype(FEAID_DTYPE)
             need_reverse = False
         else:
             keys, slots = self._sorted_items()
-        st = self._state_np(self.state)
         n = 0
         with stream.open_stream(path, "w") as f:
             for k, s in zip(keys, slots):

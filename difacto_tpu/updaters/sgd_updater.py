@@ -92,33 +92,38 @@ class SGDUpdaterParam(Param):
 class SGDState(NamedTuple):
     """Slot-table model state; all arrays have capacity+1 rows (row 0 trash).
 
-    The embedding values and their AdaGrad accumulators live in ONE array
-    ``VVg`` (f32[C, 2h]: V in [:, :k], Vg in [:, h:h+k], with h =
-    v_half(param) >= k) so the per-step gather/scatter touches a single
-    wide row per feature — TPU scatter cost scales with the number of
-    scattered rows, so one wide scatter beats two narrow ones (measured
-    ~22 ms vs ~44 ms for 131k rows, k=64). Each half is zero-padded from
-    k to h so the row is a multiple of the 128-lane tile width
-    (pad_v_rows; see SGDUpdaterParam).
+    TWO layouts, keyed on V_dim:
+
+    - ``V_dim == 0`` (linear models): flat f32 FTRL arrays w/z/sqrt_g/cnt
+      (+ v_live, vestigial), ``VVg`` is [C, 0]. The flat T(1024) scalar
+      layout is the fast form when there is no embedding row to ride.
+    - ``V_dim > 0``: EVERYTHING lives in ``VVg`` [C, Wx] and the five
+      flat fields are empty [0] placeholders (pytree/donation still sees
+      six leaves). The row is [V | pad | Vg | pad | scal]: V in [:, :k],
+      Vg in [:, h:h+k] with h = v_half(param) >= k, and the last SCAL_W
+      lanes carry the FTRL scalars (w, z, sqrt_g, cnt as f32 bit-split
+      into storage-dtype lane pairs — see pack_scal) plus the v_live
+      flag. One fused row means the step runs ONE gather + ONE scatter
+      instead of ~10 per-slot table ops; each op costs ~10-19 ns per
+      ROW regardless of width, so merging ops is the lever (measured
+      52.4 -> 37.4 ms for the u=262k V64 table-op train, 31.0 -> 21.0 ms
+      for u=196k V16 where the scalars ride the EXISTING pad lanes —
+      docs/perf_notes.md round-5 "fused scalar lanes").
+
+    Reference analog: the SGDEntry record (src/sgd/sgd_updater.h:20-69)
+    keeps w, z, sqrt_g and V[] contiguous per feature for the same
+    reason — one cache line per key.
     """
-    w: jnp.ndarray        # f32[C]
-    z: jnp.ndarray        # f32[C] FTRL dual
-    sqrt_g: jnp.ndarray   # f32[C] FTRL accumulated grad norm
-    cnt: jnp.ndarray      # f32[C] feature occurrence counts
-    VVg: jnp.ndarray      # f32[C, 2h] embeddings + AdaGrad accumulators
-    v_live: jnp.ndarray   # bool[C] embedding activated
+    w: jnp.ndarray        # f32[C] (V_dim=0) | f32[0]
+    z: jnp.ndarray        # f32[C] FTRL dual  | f32[0]
+    sqrt_g: jnp.ndarray   # f32[C] FTRL accumulated grad norm | f32[0]
+    cnt: jnp.ndarray      # f32[C] feature occurrence counts  | f32[0]
+    VVg: jnp.ndarray      # [C, Wx] fused rows (V_dim>0) | [C, 0]
+    v_live: jnp.ndarray   # bool[C] (V_dim=0, vestigial) | bool[0]
 
     @property
     def capacity(self) -> int:
-        return self.w.shape[0]
-
-    @property
-    def V(self) -> jnp.ndarray:
-        return self.VVg[:, :self.VVg.shape[1] // 2]
-
-    @property
-    def Vg(self) -> jnp.ndarray:
-        return self.VVg[:, self.VVg.shape[1] // 2:]
+        return self.VVg.shape[0]
 
 
 def v_dtype(param: SGDUpdaterParam):
@@ -129,8 +134,9 @@ def v_half(param: SGDUpdaterParam, capacity: int) -> int:
     """Stored width of each VVg half at this table capacity: V_dim
     rounded up to a multiple of 64 (so the fused [V | Vg] row is a
     multiple of the 128-lane tile) when pad_v_rows and the padded table
-    fits pad_v_rows_max_mb, else exactly V_dim. Kernels never call this —
-    they read the layout off ``VVg.shape[1] // 2``."""
+    fits pad_v_rows_max_mb, else exactly V_dim. The full row adds the
+    scalar lanes behind the halves — row_layout is the single source for
+    the complete geometry."""
     k = param.V_dim
     if k == 0 or not param.pad_v_rows:
         return k
@@ -142,11 +148,9 @@ def v_half(param: SGDUpdaterParam, capacity: int) -> int:
 
 
 def fuse_vvg(V, Vg, h: int):
-    """THE padded-row layout, in one place: [V | pad | Vg | pad] with each
-    half zero-padded from k columns to h. Accepts jnp or numpy halves;
-    every builder of a VVg array (init, growth re-layout, the update
-    write-back, checkpoint assembly) goes through here so the layout
-    cannot drift between sites."""
+    """The padded embedding halves: [V | pad | Vg | pad] with each half
+    zero-padded from k columns to h. Accepts jnp or numpy halves. The
+    fused-row builders below append the scalar lanes behind this."""
     k = V.shape[1]
     if h == k:
         return jnp.concatenate([V, Vg], axis=1)
@@ -154,45 +158,177 @@ def fuse_vvg(V, Vg, h: int):
     return jnp.concatenate([V, pad, Vg, pad], axis=1)
 
 
+# fused-row scalar section: the BYTES of f32[8] = (w, z, sqrt_g, cnt,
+# v_live-as-1.0/0.0, 3 spare) reinterpreted in the row's storage dtype —
+# 16 bfloat16 lanes or 8 f32 lanes. One contiguous minor-dim slice plus a
+# bulk bitcast_convert_type reads/writes the whole section (bit-exact for
+# bf16 storage: each f32 spans two adjacent lanes, low bits first), which
+# keeps XLA on the row-major layout — per-lane extraction with uint
+# shifts made layout assignment prefer a TRANSPOSED gather and insert a
+# full-table copy of the donated state every step (docs/perf_notes.md).
+SCAL_F32S = 8
+
+
+def scal_lanes(dtype) -> int:
+    return SCAL_F32S if dtype == jnp.float32 else 2 * SCAL_F32S
+
+
+def row_layout(param: SGDUpdaterParam, capacity: int
+               ) -> Tuple[int, int, int, int]:
+    """(k, h, Wx, off) of the fused row at this capacity: half width h
+    from v_half (budget-gated lane padding), total row width Wx, and the
+    scalar-section offset off = Wx - scal_lanes. The scalars ride INSIDE
+    the Vg-half pad when it is wide enough (V_dim <= 48 padded: zero
+    extra bytes); otherwise the row is extended to the next multiple of
+    the 128-lane tile (V_dim=64 bf16: 128 -> 256). The multiple is
+    load-bearing: a 192-lane row made XLA's entry-layout pass choose a
+    TRANSPOSED {0,1} table layout (it avoids the 192->256 tile padding),
+    which inserted two full-table transpose copies around every step's
+    gather/scatter — ~5.7 ms/step of pure copy at 2M rows
+    (docs/perf_notes.md round-5 "fused scalar lanes"). A tile-aligned
+    width costs the same HBM as the padded 192 and keeps {1,0}."""
+    k = param.V_dim
+    assert k > 0, "flat layout has no fused row"
+    h = v_half(param, capacity)
+    ns = scal_lanes(v_dtype(param))
+    Wx = 2 * h if h - k >= ns else -(-(2 * h + ns) // 128) * 128
+    return k, h, Wx, Wx - ns
+
+
+def pack_scal(w, z, sqrt_g, cnt, live, dtype):
+    """f32 scalar columns + bool live -> [n, scal_lanes] of ``dtype``."""
+    f = jnp.stack([jnp.asarray(w, jnp.float32), jnp.asarray(z, jnp.float32),
+                   jnp.asarray(sqrt_g, jnp.float32),
+                   jnp.asarray(cnt, jnp.float32),
+                   jnp.asarray(live, jnp.float32),
+                   jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w)],
+                  axis=1)
+    if dtype == jnp.float32:
+        return f
+    return jax.lax.bitcast_convert_type(f, jnp.bfloat16).reshape(
+        f.shape[0], 2 * SCAL_F32S)
+
+
+def unpack_scal(lanes):
+    """[n, scal_lanes] scalar section -> (w, z, sqrt_g, cnt, live)."""
+    if lanes.dtype == jnp.float32:
+        f = lanes
+    else:
+        f = jax.lax.bitcast_convert_type(
+            lanes.reshape(lanes.shape[0], SCAL_F32S, 2), jnp.float32)
+    return f[:, 0], f[:, 1], f[:, 2], f[:, 3], f[:, 4] > 0
+
+
+def scal_cols(param: SGDUpdaterParam, state: SGDState):
+    """(w, z, sqrt_g, cnt, v_live) as full-table columns — the host /
+    eval / checkpoint view, layout-independent. Column slices of the
+    fused rows read whole tiles, so this is a full-table pass: fine once
+    per epoch or task, never per step."""
+    if param.V_dim == 0:
+        return state.w, state.z, state.sqrt_g, state.cnt, state.v_live
+    _, _, _, off = row_layout(param, state.capacity)
+    return unpack_scal(state.VVg[:, off:])
+
+
+def col_w(param: SGDUpdaterParam, state: SGDState) -> jnp.ndarray:
+    return scal_cols(param, state)[0]
+
+
+def col_V(param: SGDUpdaterParam, state: SGDState) -> jnp.ndarray:
+    """Full-table V columns (storage dtype), pad/scal lanes stripped."""
+    if param.V_dim == 0:
+        return state.VVg
+    k, _, _, _ = row_layout(param, state.capacity)
+    return state.VVg[:, :k]
+
+
+def col_Vg(param: SGDUpdaterParam, state: SGDState) -> jnp.ndarray:
+    if param.V_dim == 0:
+        return state.VVg
+    k, h, _, _ = row_layout(param, state.capacity)
+    return state.VVg[:, h:h + k]
+
+
+def set_all_live(param: SGDUpdaterParam, state: SGDState) -> SGDState:
+    """Bench/entry helper: activate every embedding row."""
+    if param.V_dim == 0:
+        return state._replace(v_live=jnp.ones_like(state.v_live))
+    _, _, _, off = row_layout(param, state.capacity)
+    w, z, sg, cnt, _ = unpack_scal(state.VVg[:, off:])
+    scal = pack_scal(w, z, sg, cnt, jnp.ones_like(w, bool), state.VVg.dtype)
+    return state._replace(
+        VVg=jnp.concatenate([state.VVg[:, :off], scal], axis=1))
+
+
+def build_rows(param: SGDUpdaterParam, capacity: int, V, Vg,
+               w, z, sqrt_g, cnt, live) -> jnp.ndarray:
+    """Assemble full fused rows [V | pad | Vg | pad | scal] at this
+    capacity's layout from f32 parts. Every builder (init, growth
+    re-layout, checkpoint assembly) goes through here so the layout
+    cannot drift between sites."""
+    _, h, Wx, off = row_layout(param, capacity)
+    dt = v_dtype(param)
+    halves = fuse_vvg(jnp.asarray(V, jnp.float32),
+                      jnp.asarray(Vg, jnp.float32), h).astype(dt)
+    scal = pack_scal(jnp.asarray(w, jnp.float32), jnp.asarray(z, jnp.float32),
+                     jnp.asarray(sqrt_g, jnp.float32),
+                     jnp.asarray(cnt, jnp.float32),
+                     jnp.asarray(live), dt)
+    # in-pad layout (off < 2h): the scal section replaces the tail of the
+    # Vg-half pad; appended layout: zero gap lanes between halves and scal
+    if off <= 2 * h:
+        return jnp.concatenate([halves[:, :off], scal], axis=1)
+    gap = jnp.zeros((halves.shape[0], off - 2 * h), dt)
+    return jnp.concatenate([halves, gap, scal], axis=1)
+
+
 def init_state(param: SGDUpdaterParam, capacity: int) -> SGDState:
-    k, h = param.V_dim, v_half(param, capacity)
+    k = param.V_dim
+    if k == 0:
+        def zeros():
+            # distinct buffers — donate_argnums forbids aliased leaves
+            return jnp.zeros(capacity, dtype=jnp.float32)
+        return SGDState(
+            w=zeros(), z=zeros(), sqrt_g=zeros(), cnt=zeros(),
+            VVg=jnp.zeros((capacity, 0), jnp.float32),
+            v_live=jnp.zeros(capacity, dtype=bool))
     key = jax.random.PRNGKey(param.seed)
     V = (jax.random.uniform(key, (capacity, k), dtype=jnp.float32) - 0.5) \
         * param.V_init_scale
-    def zeros():
-        # distinct buffers — donate_argnums forbids aliased leaves
-        return jnp.zeros(capacity, dtype=jnp.float32)
-    return SGDState(
-        w=zeros(), z=zeros(), sqrt_g=zeros(), cnt=zeros(),
-        VVg=fuse_vvg(V, jnp.zeros((capacity, k), jnp.float32),
-                     h).astype(v_dtype(param)),
-        v_live=jnp.zeros(capacity, dtype=bool),
-    )
+    _, _, Wx, _ = row_layout(param, capacity)
+    # all-zero scalar lanes already encode (w,z,sqrt_g,cnt,live) =
+    # (0,0,0,0,False) in both dtypes, so only the V block needs writing
+    T = jnp.zeros((capacity, Wx), v_dtype(param)
+                  ).at[:, :k].set(V.astype(v_dtype(param)))
+    empty = jnp.zeros(0, jnp.float32)
+    return SGDState(w=empty, z=empty + 0, sqrt_g=empty + 0, cnt=empty + 0,
+                    VVg=T, v_live=jnp.zeros(0, dtype=bool))
 
 
 def grow_state(param: SGDUpdaterParam, state: SGDState, new_capacity: int
                ) -> SGDState:
     """Double-and-copy growth; new V rows get fresh init values. Growth
     can cross the pad_v_rows_max_mb threshold, shrinking v_half back to
-    V_dim — old rows are re-laid-out to the new half width."""
+    V_dim — old rows are re-laid-out to the new row width (their scalar
+    lanes move with the scal offset)."""
     old = state.capacity
     if new_capacity <= old:
         return state
     ext = init_state(param, new_capacity)
-    if param.V_dim and ext.VVg.shape[1] != state.VVg.shape[1]:
-        k = param.V_dim
-        oh, nh = state.VVg.shape[1] // 2, ext.VVg.shape[1] // 2
-        state = state._replace(VVg=fuse_vvg(
-            state.VVg[:, :k], state.VVg[:, oh:oh + k], nh))
+    # compare the FULL geometry, not the width: crossing the
+    # pad_v_rows_max_mb gate at V_dim<=48 keeps Wx=128 while h moves
+    # (64 -> k), so a width-equality guard would silently leave Vg at
+    # the old offset (advisor round-5 finding, reproduced: grown rows
+    # read Vg=0 from the old V-pad lanes)
+    if param.V_dim and row_layout(param, old) != row_layout(param,
+                                                            new_capacity):
+        k, h, _, off = row_layout(param, old)
+        w, z, sg, cnt, live = unpack_scal(state.VVg[:, off:])
+        state = state._replace(VVg=build_rows(
+            param, new_capacity, state.VVg[:, :k].astype(jnp.float32),
+            state.VVg[:, h:h + k].astype(jnp.float32), w, z, sg, cnt, live))
     return SGDState(*(jnp.concatenate([a, jnp.asarray(b)[old:]], axis=0)
                       for a, b in zip(state, ext)))
-
-
-def _refresh_v_live(param: SGDUpdaterParam, state: SGDState) -> jnp.ndarray:
-    if param.V_dim == 0:
-        return state.v_live
-    return state.v_live | ((state.w != 0)
-                           & (state.cnt > float(param.V_threshold)))
 
 
 def make_fns(param: SGDUpdaterParam):
@@ -219,42 +355,14 @@ def make_fns(param: SGDUpdaterParam):
         return arr.at[slots].set(rows, indices_are_sorted=True,
                                  unique_indices=True, mode="drop")
 
-    def get_rows(state: SGDState, slots: jnp.ndarray
-                 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
-                            Optional[jnp.ndarray]]:
-        """Pull [w, V, v_mask] rows for the batch's unique slots (Get)."""
-        w = _gather(state.w, slots)
-        if not has_V:
-            return w, None, None
-        vmask = _gather(state.v_live, slots)
-        if param.l1_shrk:
-            vmask = vmask & (w != 0)
-        # gather FULL [V|Vg] rows then slice: a partial-row gather
-        # (VVg[slots, :k]) lowers to a strided gather that is ~8x slower;
-        # the full-row gather is CSE'd with apply_grad's in the fused step.
-        # V keeps its STORAGE dtype (param.V_dtype) so the loss's per-token
-        # gather can ride bf16 — the update math casts to f32 itself.
-        V = _gather(state.VVg, slots)[:, :param.V_dim]
-        return w, V, vmask.astype(jnp.float32)
+    thr = float(param.V_threshold)
 
-    def apply_count(state: SGDState, slots: jnp.ndarray, counts: jnp.ndarray
-                    ) -> SGDState:
-        """kFeaCount push (Update, sgd_updater.cc:64-75). Sorted unique
-        slots with out-of-bounds padding (dropped)."""
-        cnt = state.cnt.at[slots].add(counts, indices_are_sorted=True,
-                                      unique_indices=True, mode="drop")
-        state = state._replace(cnt=cnt)
-        return state._replace(v_live=_refresh_v_live(param, state))
+    def _layout(state):
+        return row_layout(param, state.capacity)
 
-    def apply_grad(state: SGDState, slots: jnp.ndarray,
-                   gw: jnp.ndarray, gV: Optional[jnp.ndarray],
-                   pull_vmask: Optional[jnp.ndarray]) -> SGDState:
-        """kGradient push: FTRL(w) + AdaGrad(V). ``slots`` are sorted unique
-        (padding -> TRASH_SLOT, whose gw must be 0)."""
-        w = _gather(state.w, slots)
-        sg = _gather(state.sqrt_g, slots)
-        z = _gather(state.z, slots)
-
+    def _ftrl(w, z, sg, gw):
+        """The FTRL-proximal w update (UpdateW, sgd_updater.cc:105-131),
+        identical math in both layouts."""
         g = gw + l2 * w
         sg_new = jnp.sqrt(sg * sg + g * g)
         z_new = z - (g - (sg_new - sg) / lr * w)
@@ -262,39 +370,104 @@ def make_fns(param: SGDUpdaterParam):
         w_new = jnp.where(
             jnp.abs(z_new) <= l1, 0.0,
             (z_new - jnp.sign(z_new) * l1) / eta)
+        return w_new, z_new, sg_new
 
-        state = state._replace(
-            w=_scatter(state.w, slots, w_new),
-            sqrt_g=_scatter(state.sqrt_g, slots, sg_new),
-            z=_scatter(state.z, slots, z_new),
-        )
+    def get_rows(state: SGDState, slots: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
+                            Optional[jnp.ndarray]]:
+        """Pull [w, V, v_mask] rows for the batch's unique slots (Get)."""
+        if not has_V:
+            return _gather(state.w, slots), None, None
+        # ONE full fused-row gather serves w, V AND the live flag; it is
+        # CSE'd with apply_grad's gather of the same rows in the fused
+        # train step. A partial-row gather (VVg[slots, :k]) would lower
+        # to a strided gather ~8x slower. V keeps its STORAGE dtype
+        # (param.V_dtype) so the loss's per-token gather can ride bf16.
+        _, _, _, off = _layout(state)
+        rows = _gather(state.VVg, slots)
+        w, _, _, _, live = unpack_scal(rows[:, off:])
+        vmask = live
+        if param.l1_shrk:
+            vmask = vmask & (w != 0)
+        return w, rows[:, :param.V_dim], vmask.astype(jnp.float32)
 
-        if has_V and gV is not None:
-            # ONE gather + ONE scatter over the fused [V | pad | Vg | pad]
-            # rows; the half width rides the array shape (v_half)
-            h = state.VVg.shape[1] // 2
-            VVg = _gather(state.VVg, slots).astype(jnp.float32)
-            V = VVg[:, :param.V_dim]
-            Vg = VVg[:, h:h + param.V_dim]
+    def apply_count(state: SGDState, slots: jnp.ndarray, counts: jnp.ndarray
+                    ) -> SGDState:
+        """kFeaCount push (Update, sgd_updater.cc:64-75). Sorted unique
+        slots with out-of-bounds padding (dropped). Touched rows also
+        re-evaluate their lazy-V activation (InitV trigger,
+        sgd_updater.cc:71-74) — untouched rows cannot flip, their (w,
+        cnt) did not change."""
+        if not has_V:
+            cnt = state.cnt.at[slots].add(counts, indices_are_sorted=True,
+                                          unique_indices=True, mode="drop")
+            return state._replace(cnt=cnt)
+        _, _, _, off = _layout(state)
+        rows = _gather(state.VVg, slots)
+        w, z, sg, cnt, live = unpack_scal(rows[:, off:])
+        cnt_new = cnt + counts
+        live_new = live | ((w != 0) & (cnt_new > thr))
+        scal = pack_scal(w, z, sg, cnt_new, live_new, state.VVg.dtype)
+        out = jnp.concatenate([rows[:, :off], scal], axis=1)
+        return state._replace(VVg=_scatter(state.VVg, slots, out))
+
+    def apply_grad(state: SGDState, slots: jnp.ndarray,
+                   gw: jnp.ndarray, gV: Optional[jnp.ndarray],
+                   pull_vmask: Optional[jnp.ndarray]) -> SGDState:
+        """kGradient push: FTRL(w) + AdaGrad(V). ``slots`` are sorted unique
+        (padding -> TRASH_SLOT, whose gw must be 0)."""
+        if not has_V:
+            w = _gather(state.w, slots)
+            sg = _gather(state.sqrt_g, slots)
+            z = _gather(state.z, slots)
+            w_new, z_new, sg_new = _ftrl(w, z, sg, gw)
+            return state._replace(
+                w=_scatter(state.w, slots, w_new),
+                sqrt_g=_scatter(state.sqrt_g, slots, sg_new),
+                z=_scatter(state.z, slots, z_new))
+
+        k, h, _, off = _layout(state)
+        rows = _gather(state.VVg, slots)
+        w, z, sg, cnt, live = unpack_scal(rows[:, off:])
+        w_new, z_new, sg_new = _ftrl(w, z, sg, gw)
+        # lazy-V activation on the touched rows (the union of the
+        # reference's two trigger sites re-evaluated after the update)
+        live_new = live | ((w_new != 0) & (cnt > thr))
+        scal = pack_scal(w_new, z_new, sg_new, cnt, live_new,
+                         state.VVg.dtype)
+
+        if gV is not None:
+            V = rows[:, :k].astype(jnp.float32)
+            Vg = rows[:, h:h + k].astype(jnp.float32)
             gv = gV + V_l2 * V
             Vg_new = jnp.sqrt(Vg * Vg + gv * gv)
             V_new = V - V_lr / (Vg_new + V_lr_beta) * gv
+            # AdaGrad only touches rows whose embedding was PULLED this
+            # batch (lens[i] > 1 semantics, sgd_updater.cc:91-96)
             upd = pull_vmask[:, None] > 0
-            new_rows = jnp.where(upd, fuse_vvg(V_new, Vg_new, h), VVg)
-            state = state._replace(
-                VVg=_scatter(state.VVg, slots,
-                             new_rows.astype(state.VVg.dtype)))
-
-        return state._replace(v_live=_refresh_v_live(param, state))
+            emb = jnp.where(upd, fuse_vvg(V_new, Vg_new, h),
+                            rows[:, :2 * h].astype(jnp.float32)
+                            ).astype(state.VVg.dtype)
+        else:
+            emb = rows[:, :2 * h]
+        # in-pad layout: scal replaces the tail of emb's own pad lanes;
+        # appended layout: the gap lanes between are carried through
+        if off <= 2 * h:
+            out = jnp.concatenate([emb[:, :off], scal], axis=1)
+        else:
+            out = jnp.concatenate([emb, rows[:, 2 * h:off], scal], axis=1)
+        return state._replace(VVg=_scatter(state.VVg, slots, out))
 
     def evaluate(state: SGDState) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        """(penalty, nnz) over real rows (Evaluate, sgd_updater.cc:15-32)."""
-        w = state.w.at[TRASH_SLOT].set(0.0)
+        """(penalty, nnz) over real rows (Evaluate, sgd_updater.cc:15-32).
+        Full-table column reads of the fused rows — once per epoch."""
+        w, _, _, _, live = scal_cols(param, state)
+        w = w.at[TRASH_SLOT].set(0.0)
         penalty = jnp.sum(l1 * jnp.abs(w) + 0.5 * l2 * w * w)
         nnz = jnp.sum((w != 0).astype(jnp.float32))
         if has_V:
-            live = state.v_live.at[TRASH_SLOT].set(False)
-            Vm = state.V * live[:, None]
+            live = live.at[TRASH_SLOT].set(False)
+            Vm = col_V(param, state).astype(jnp.float32) * live[:, None]
             # quirk preserved: Evaluate charges l2 (not V_l2) on V
             penalty = penalty + jnp.sum(0.5 * l2 * Vm * Vm)
             nnz = nnz + jnp.sum(live) * param.V_dim

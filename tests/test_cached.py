@@ -96,9 +96,11 @@ def test_cached_parity_heavy_collisions(rcv1_rec, rcv1_path):
     got, learner_got = run_trajectory(rcv1_rec, "rec", 61)
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
     # the final tables agree too (same slots, same aliased weights)
+    from difacto_tpu.updaters.sgd_updater import col_w
     np.testing.assert_allclose(
-        np.asarray(learner_got.store.state.w),
-        np.asarray(learner_ref.store.state.w), rtol=1e-5, atol=1e-6)
+        np.asarray(col_w(learner_got.store.param, learner_got.store.state)),
+        np.asarray(col_w(learner_ref.store.param, learner_ref.store.state)),
+        rtol=1e-5, atol=1e-6)
     # and collisions actually happened (otherwise this test is vacuous)
     blk, uniq = read_rec_block_ex(
         sorted(expand_uri(rcv1_rec))[0])

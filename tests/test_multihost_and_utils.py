@@ -104,7 +104,8 @@ def test_hashed_push_collision_aggregates():
     s2.push(np.array([5], dtype=np.uint64), K_GRADIENT,
             np.array([3.0], np.float32), gv.sum(0, keepdims=True),
             np.array([1.0], np.float32))
-    np.testing.assert_allclose(np.asarray(s1.state.w), np.asarray(s2.state.w))
+    # the fused rows carry w, the FTRL aux AND the embeddings — one
+    # array compare covers the whole table
     np.testing.assert_allclose(np.asarray(s1.state.VVg),
                                np.asarray(s2.state.VVg))
 
@@ -123,7 +124,8 @@ def test_hashed_learner_with_heavy_collisions(rcv1_path):
         seen = []
         ln.add_epoch_end_callback(lambda e, t, v: seen.append(t.loss))
         ln.run()
-        return np.asarray(ln.store.state.w), seen
+        from difacto_tpu.updaters.sgd_updater import col_w
+        return np.asarray(col_w(ln.store.param, ln.store.state)), seen
 
     w1, seen1 = run()
     w2, seen2 = run()
@@ -143,7 +145,8 @@ def test_hashed_store_deterministic_across_instances(rcv1_path):
                  ("stop_rel_objv", "0"), ("num_jobs_per_epoch", "1"),
                  ("hash_capacity", "32768")])
         ln.run()
-        return np.asarray(ln.store.state.w)
+        from difacto_tpu.updaters.sgd_updater import col_w
+        return np.asarray(col_w(ln.store.param, ln.store.state))
 
     np.testing.assert_array_equal(run(), run())
 

@@ -29,13 +29,14 @@ def test_make_mesh_shapes():
 
 
 def test_state_sharded_over_fs():
+    from difacto_tpu.updaters.sgd_updater import col_V
     mesh = make_mesh(dp=2, fs=4)
-    state = init_state(SGDUpdaterParam(V_dim=4), 1 << 10)
+    param = SGDUpdaterParam(V_dim=4)
+    state = init_state(param, 1 << 10)
     sharded = shard_pytree(state, state_sharding(mesh))
-    assert sharded.w.sharding == NamedSharding(mesh, P("fs"))
-    assert sharded.V.sharding == NamedSharding(mesh, P("fs", None))
-    np.testing.assert_array_equal(np.asarray(sharded.V),
-                                  np.asarray(state.V))
+    assert sharded.VVg.sharding == NamedSharding(mesh, P("fs", None))
+    np.testing.assert_array_equal(np.asarray(col_V(param, sharded)),
+                                  np.asarray(col_V(param, state)))
 
 
 def _run(rcv1_path, **over):

@@ -60,7 +60,8 @@ def main() -> None:
     fns = make_fns(param)
     loss = create("fm", k)
     state0 = init_state(param, args.capacity)
-    state0 = state0._replace(v_live=jnp.ones(args.capacity, dtype=bool))
+    from difacto_tpu.updaters.sgd_updater import set_all_live
+    state0 = set_all_live(param, state0)
     # host-side template: each variant donates its own device copy (a
     # shared device state would be deleted by the first donation)
     state0 = jax.tree.map(np.asarray, state0)
