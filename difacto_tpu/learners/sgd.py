@@ -1370,7 +1370,18 @@ class SGDLearner(Learner):
         from the staging pass so the compile overlaps its streaming;
         replay pairs only once the executable is ready, so the compile
         never extends any epoch (a paired first call would cost ~18 s
-        in-line — measured, epoch 2 of the criteo V16 run)."""
+        in-line — measured, epoch 2 of the criteo V16 run).
+
+        The pair program is compiled with has_cnt=False regardless of the
+        payload statics: it serves REPLAY epochs only, whose counts tail
+        is zeroed (_zero_counts), and with the fused-row table a
+        zero-count apply_count costs a full row gather+scatter per step —
+        measured ~8 ms/step at the avazu shape, +35% on the epoch. The
+        count-side v_live refresh it would perform is subsumed: cnt is
+        frozen during replay, so any (w!=0 & cnt>thr) activation can only
+        arise from a w change, which apply_grad's own per-row refresh
+        already handles. unpack_panel with has_counts=False simply never
+        reads the (zeroed) tail of the staged f32 buffer."""
         key = statics
         if key in self._pair_execs or self.mesh is not None:
             return
@@ -1382,11 +1393,12 @@ class SGDLearner(Learner):
 
         state_s = jax.tree_util.tree_map(sds, self.store.state)
         pa = tuple(sds(t) for t in arrays)
+        b_cap, width, u_cap, _, binary = key
 
         def build():
             try:
                 lowered = self._packed_panel_train_chunked2.lower(
-                    state_s, pa, pa, *key)
+                    state_s, pa, pa, b_cap, width, u_cap, False, binary)
                 self._pair_execs[key] = lowered.compile()
             except Exception as e:  # pragma: no cover - best-effort warm
                 log.warning("pair-replay precompile failed "

@@ -302,3 +302,52 @@ def test_producer_pool_escalates_after_max_retries():
         for part, item in pool:
             got.append((part, item))
     assert got == [(0, (0, i)) for i in range(3)]
+
+
+def test_paired_replay_without_counts_matches(tmp_path, monkeypatch):
+    """Replay PAIRS dispatch through an executable compiled WITHOUT the
+    counts section (replay counts are zeroed; apply_grad's per-row
+    activation refresh subsumes the count-side one — learners/sgd.py
+    _warm_pair_exec) and must reproduce the streamed trajectory exactly,
+    with feature counts still pushed exactly once. The background pair
+    compile is forced synchronous so pairing deterministically engages
+    from epoch 1 (on CPU the compile otherwise races the tiny epochs and
+    the pair path would go untested)."""
+    import threading as real_threading
+
+    import difacto_tpu.learners.sgd as sgd_mod
+
+    class _SyncThread:
+        def __init__(self, target=None, **kw):
+            self._target = target
+
+        def start(self):
+            self._target()
+
+    class _ThreadingShim:
+        Thread = _SyncThread
+
+        def __getattr__(self, name):
+            return getattr(real_threading, name)
+
+    monkeypatch.setattr(sgd_mod, "threading", _ThreadingShim())
+    # a UNIFORM-width dataset: the panel layout (and so the chunked pair
+    # path) only engages when rows are near-uniform; the ragged rcv1
+    # fixture packs COO and never pairs
+    rng = np.random.RandomState(5)
+    d = tmp_path
+    with open(d / "uniform.libsvm", "w") as f:
+        for _ in range(200):
+            feats = rng.choice(500, 8, replace=False) + 1
+            cols = " ".join(f"{int(j)}:1" for j in np.sort(feats))
+            f.write(f"{int(rng.randint(0, 2))} {cols}\n")
+    rec = convert_to_rec(str(d / "uniform.libsvm"), str(d / "uniform.rec"),
+                         rec_batch_size=25)
+    ref, base = run_trajectory(rec, "rec", 1 << 14, device_cache_mb="0")
+    got, learner = run_trajectory(rec, "rec", 1 << 14, device_cache_mb="256")
+    assert getattr(learner, "_paired_dispatches", 0) > 0
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+    from difacto_tpu.updaters.sgd_updater import scal_cols
+    np.testing.assert_allclose(
+        np.asarray(scal_cols(learner.store.param, learner.store.state)[3]),
+        np.asarray(scal_cols(base.store.param, base.store.state)[3]))
