@@ -238,8 +238,8 @@ def run_e2e(args) -> dict:
         streamed_epochs = 3
         # 4 GB cache: the 1.8M-row window at batch 65536 stages ~2.2 GB of
         # packed+chunked batches — comfortably inside this 16 GB chip next
-        # to the 545 MB table, and the bigger batch halves the per-step
-        # dispatch overhead (705k -> ~820k ex/s measured across runs;
+        # to the ~1.1 GB fused-row table, and the bigger batch halves the
+        # per-step dispatch overhead (~1.28M ex/s replay as of round 5;
         # run-to-run spread on the tunneled chip is a few percent)
         replay, cache_info = train(4096, epochs)
         streamed, _ = train(0, streamed_epochs)

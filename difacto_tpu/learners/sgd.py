@@ -63,11 +63,14 @@ class _DeviceBatchCache:
     The hashed store stages on its FIRST pass: its capacity is fixed, so
     cached slot vectors (including their out-of-bounds padding) stay
     truthful forever. The dictionary store can GROW, which would pull
-    padded indices back in bounds — it stages on its SECOND pass
-    (``stage_after_pass=1``): one full pass over fixed data inserts every
-    feature, so the dictionary is complete and the capacity frozen; a
-    capacity change after staging (impossible for fixed data, guarded
-    anyway) invalidates the cache back to streaming. Shuffle degrades to
+    padded indices back in bounds — but slot assignment itself is
+    insertion-stable, so on a single host it ALSO stages on pass one
+    and the replay entry rewrites each staged pad tail to the live
+    capacity (``repadable`` / learner._repad_cache; round-5 — the old
+    second-pass staging paid a whole extra streamed epoch). The MESH
+    dictionary keeps ``stage_after_pass=1`` (its payloads are sharded
+    global pairs) and any capacity change after staging invalidates the
+    cache back to streaming. Shuffle degrades to
     a per-epoch permutation of cached batches within each part
     (row->batch assignment is frozen at staging time); neg_sampling != 1
     disables the cache (each epoch must resample).
@@ -86,11 +89,16 @@ class _DeviceBatchCache:
     """
 
     def __init__(self, budget_mb: int, shared: Optional[dict] = None,
-                 stage_after_pass: int = 0) -> None:
+                 stage_after_pass: int = 0, repadable: bool = False) -> None:
         """``shared`` is a mutable ``{"used": bytes}`` pool: all caches of
         one learner (training + validation) draw from the SAME
         device_cache_mb budget, so actual HBM held never exceeds the
-        configured cap however many job types cache."""
+        configured cap however many job types cache.
+
+        ``repadable``: staged payloads' OOB slot padding can be rewritten
+        for a grown table (the single-host dictionary path — slot
+        assignment is insertion-stable, only the padding aliases), so
+        capacity growth marks the pads stale instead of invalidating."""
         self.budget = budget_mb << 20
         self.shared = shared if shared is not None else {"used": 0}
         self.used = 0
@@ -100,6 +108,8 @@ class _DeviceBatchCache:
         self.alive = True
         self.frozen = False       # True once the budget filled mid-pass
         self.stage_after_pass = stage_after_pass
+        self.repadable = repadable
+        self.stale_pads = False   # some payloads padded at an older capacity
         self.passes = 0
         self.capacity: Optional[int] = None  # store capacity at staging
 
@@ -151,8 +161,15 @@ class _DeviceBatchCache:
             if self.capacity is None:
                 self.capacity = capacity
             elif self.capacity != capacity:
-                self.invalidate("store capacity grew during staging")
-                return
+                if self.repadable:
+                    # dictionary growth mid-staging: earlier payloads'
+                    # OOB padding is now stale; the replay entry repads
+                    # them (learner._repad_cache) instead of refetching
+                    self.capacity = capacity
+                    self.stale_pads = True
+                else:
+                    self.invalidate("store capacity grew during staging")
+                    return
         if self.shared["used"] + nbytes > self.budget:
             self._freeze(part, f"budget {self.budget >> 20} MB filled")
             return
@@ -1337,12 +1354,19 @@ class SGDLearner(Learner):
             self._dev_caches = {}
             self._dev_cache_pool = {"used": 0}  # one budget across jobs
         if job_type not in self._dev_caches:
-            # dictionary stores stage on their SECOND pass (the first
-            # pass completes the dictionary and freezes capacity — see
-            # the _DeviceBatchCache docstring)
+            # single-host dictionary stores stage on their FIRST pass and
+            # repad the staged OOB slot tails once the dictionary freezes
+            # (slot assignment is insertion-stable, so growth only stales
+            # the padding — _repad_cache). The MESH dictionary keeps
+            # second-pass staging: its staged payloads are sharded global
+            # (batch, slots) pairs whose repad would have to run
+            # identically on every host.
+            dict_single = not self.store.hashed and self.mesh is None
             self._dev_caches[job_type] = _DeviceBatchCache(
                 p.device_cache_mb, shared=self._dev_cache_pool,
-                stage_after_pass=0 if self.store.hashed else 1)
+                stage_after_pass=0 if (self.store.hashed or dict_single)
+                else 1,
+                repadable=dict_single)
         return self._dev_caches[job_type]
 
     def device_cache_info(self) -> dict:
@@ -1364,6 +1388,48 @@ class SGDLearner(Learner):
             }
         return out
 
+    def _repad_cache(self, cache: _DeviceBatchCache) -> None:
+        """Rewrite every staged payload's OOB slot padding for the LIVE
+        table capacity. Dictionary slot assignment is insertion-stable
+        (growth never moves a slot), so only the ascending pad tail —
+        pad_slots_oob wrote ``capacity-at-pack-time + i`` — goes stale:
+        after growth those ids fall IN bounds, alias real rows, and can
+        duplicate real slots in the same vector (the kernels declare
+        unique indices). ``nu`` rides the payload meta, so the rewrite
+        is one tiny jitted op per staged batch; buffers stay on device
+        and the cache accounting is unchanged (same sizes)."""
+        if not hasattr(self, "_repad_i32"):
+            def repad_i32(i32, off, u_cap, cap):
+                nu = i32[off + u_cap + 1]
+                j = jnp.arange(u_cap, dtype=jnp.int32)
+                slots = i32[off:off + u_cap]
+                fresh = jnp.where(j < nu, slots, cap + j - nu)
+                return i32.at[off:off + u_cap].set(fresh)
+            self._repad_i32 = jax.jit(repad_i32, static_argnums=(1, 2, 3),
+                                      donate_argnums=0)
+        cap = self.store.state.capacity
+        for items in cache.entries.values():
+            for i, p in enumerate(items):
+                if p[0] == "panel_chunked":
+                    off = p[6] * p[7]
+                    items[i] = (p[0], self._repad_i32(p[1], off, p[8], cap),
+                                *p[2:])
+                elif p[0] == "panel":
+                    _, i32, f32, b_cap, d2, u_cap = p[:6]
+                    items[i] = (p[0], self._repad_i32(i32, b_cap * d2,
+                                                      u_cap, cap),
+                                *p[2:])
+                elif p[0] == "coo":
+                    _, i32, f32, b_cap, nnz_cap, u_cap = p[:6]
+                    items[i] = (p[0], self._repad_i32(i32, 2 * nnz_cap,
+                                                      u_cap, cap),
+                                *p[2:])
+                else:  # pragma: no cover - devbatch payloads never repad
+                    raise ValueError(f"cannot repad payload {p[0]!r}")
+        cache.capacity = cap
+        cache.stale_pads = False
+        log.info("device cache repadded to capacity %d", cap)
+
     def _warm_pair_exec(self, arrays, statics) -> None:
         """Background-compile the two-batches-per-dispatch replay variant
         (packed_panel_train_chunked2) for this payload shape. Launched
@@ -1381,10 +1447,21 @@ class SGDLearner(Learner):
         frozen during replay, so any (w!=0 & cnt>thr) activation can only
         arise from a w change, which apply_grad's own per-row refresh
         already handles. unpack_panel with has_counts=False simply never
-        reads the (zeroed) tail of the staged f32 buffer."""
-        key = statics
+        reads the (zeroed) tail of the staged f32 buffer.
+
+        The exec key includes the TABLE CAPACITY: a dictionary store can
+        grow between the warm and the replay (an exec compiled at an
+        intermediate capacity would fail the AOT shape check), so a
+        stale-capacity exec is simply never found and the replay entry
+        re-warms at the live capacity."""
+        key = statics + (self.store.state.capacity,)
         if key in self._pair_execs or self.mesh is not None:
             return
+        # evict same-shape execs compiled at older capacities: each is a
+        # dead ~18 s XLA artifact after dictionary growth, and repeated
+        # growths would otherwise accumulate them for the life of the run
+        for stale in [k for k in self._pair_execs if k[:-1] == statics]:
+            del self._pair_execs[stale]
         self._pair_execs[key] = None  # claimed; ready when not None
 
         def sds(x):
@@ -1393,7 +1470,7 @@ class SGDLearner(Learner):
 
         state_s = jax.tree_util.tree_map(sds, self.store.state)
         pa = tuple(sds(t) for t in arrays)
-        b_cap, width, u_cap, _, binary = key
+        b_cap, width, u_cap, _, binary = statics
 
         def build():
             try:
@@ -1458,12 +1535,15 @@ class SGDLearner(Learner):
                                           auc=prog.auc)
                 exec_ = None
                 if is_train and payload[0] == "panel_chunked":
-                    key = payload[6:11]
+                    statics = payload[6:11]
+                    key = statics + (self.store.state.capacity,)
                     if key not in self._pair_execs:
-                        # cache staged before the warm hook existed for
-                        # this shape (e.g. a resumed process): compile in
-                        # the background, pair from the NEXT epoch on
-                        self._warm_pair_exec(payload[1:6], key)
+                        # no exec for this shape AT THIS CAPACITY yet —
+                        # the cache staged before the warm hook existed
+                        # (a resumed process), or the dictionary grew
+                        # past the warm-time capacity: compile in the
+                        # background, pair from the NEXT epoch on
+                        self._warm_pair_exec(payload[1:6], statics)
                     exec_ = self._pair_execs.get(key)
                 if exec_ is not None:
                     if held is None:
@@ -1518,8 +1598,18 @@ class SGDLearner(Learner):
         cache = self._get_cache(job_type)
         stream_parts = list(range(n_jobs))
         if cache is not None and cache.ready:
-            if (cache.capacity is not None
-                    and cache.capacity != self.store.state.capacity):
+            stale = (cache.capacity is not None
+                     and (cache.stale_pads
+                          or cache.capacity != self.store.state.capacity))
+            if stale and cache.repadable:
+                # dictionary growth since packing: rewrite each staged
+                # slot tail to pad out-of-bounds at the LIVE capacity —
+                # stale pads fall IN bounds and would alias real rows
+                # (and duplicate indices under the kernels' unique-slots
+                # declaration)
+                self._repad_cache(cache)
+                stale = False
+            if stale:
                 # staged slot padding is only truthful at the staging
                 # capacity (pad_slots_oob) — impossible for fixed data,
                 # guarded anyway
@@ -1854,9 +1944,13 @@ class SGDLearner(Learner):
                 # start the pair-replay compile while this staging pass
                 # still streams (it has ~30s of host/transfer time to
                 # hide the ~18s compile behind) — unless that add just
-                # froze or invalidated the cache, in which case no
-                # replay will ever use the executable
-                if cache.staging:
+                # froze or invalidated the cache (no replay will ever
+                # use the executable), or the cache is repadable (the
+                # dictionary table is still growing this pass: an exec
+                # compiled now would be keyed at a soon-stale capacity;
+                # the replay entry warms it at the frozen capacity and
+                # pairs from epoch 2 on)
+                if cache.staging and not cache.repadable:
                     self._warm_pair_exec((i32, f32, ci, cl, cv),
                                          (b_cap, d2, u_cap, wc, binary))
             else:

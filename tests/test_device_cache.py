@@ -96,24 +96,31 @@ def run_dict(rcv1_path, epochs=6, extra_callback=None, **over):
     return np.array(seen), learner
 
 
-def test_dictionary_store_caches_after_second_pass(rcv1_path):
-    """The dictionary store stages on its SECOND pass (pass one completes
-    the dictionary and freezes capacity); replayed epochs 2+ reproduce
-    the streamed trajectory exactly."""
-    ref, _ = run_dict(rcv1_path, device_cache_mb=0)
-    got, learner = run_dict(rcv1_path, device_cache_mb=256)
+def test_dictionary_store_caches_first_pass_with_repad(rcv1_path):
+    """The single-host dictionary store stages on its FIRST pass even
+    though the table grows mid-epoch (slot assignment is
+    insertion-stable; the replay entry repads the staged OOB slot tails
+    to the final capacity — round-5, replacing the second-pass staging
+    that paid a whole extra streamed epoch). Replayed epochs 1+
+    reproduce the streamed trajectory exactly."""
+    ref, _ = run_dict(rcv1_path, device_cache_mb=0, init_capacity=64)
+    got, learner = run_dict(rcv1_path, device_cache_mb=256,
+                            init_capacity=64)
     np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
     cache = learner._dev_caches[K_TRAINING]
-    assert cache.ready and cache.stage_after_pass == 1
+    assert cache.ready and cache.stage_after_pass == 0 and cache.repadable
+    # init_capacity=64 forces growth DURING the staging pass, so the
+    # repad path really ran (stale flag set then cleared at replay)
     assert cache.capacity == learner.store.state.capacity
+    assert not cache.stale_pads
     assert sum(len(v) for v in cache.entries.values()) == 4  # 100/25
 
 
-def test_dictionary_cache_invalidates_on_capacity_growth(rcv1_path):
-    """A capacity change after staging (impossible for fixed data,
-    guarded anyway) must invalidate the cache — the staged OOB slot
-    padding would fall back in bounds — and training falls back to
-    streaming with the trajectory unchanged."""
+def test_dictionary_cache_repads_on_capacity_growth(rcv1_path):
+    """A capacity change after staging (impossible for fixed data, but
+    the guard covers it) repads the staged OOB slot tails instead of
+    throwing the cache away — stale pads would fall back in bounds and
+    alias real rows; the trajectory must be unchanged either way."""
     ref, _ = run_dict(rcv1_path, device_cache_mb=0, epochs=5)
 
     def grow_after_epoch(learner, e):
@@ -127,7 +134,8 @@ def test_dictionary_cache_invalidates_on_capacity_growth(rcv1_path):
     seen, learner = run_dict(rcv1_path, device_cache_mb=256, epochs=5,
                              extra_callback=grow_after_epoch)
     cache = learner._dev_caches[K_TRAINING]
-    assert not cache.alive  # invalidated by the capacity guard
+    assert cache.alive and cache.ready  # repadded, NOT invalidated
+    assert cache.capacity == learner.store.state.capacity
     np.testing.assert_allclose(seen, ref, rtol=1e-6, atol=1e-6)
 
 
@@ -355,3 +363,32 @@ def test_stream_chunks_binary_panel(tmp_path):
     assert payload[0] == "panel_chunked"
     ci, cl, cv = payload[3]
     assert cv is None and payload[4] is True  # binary
+
+
+def test_non_repadable_cache_invalidates_on_growth_mid_staging():
+    """The invalidate arm still guards non-repadable caches (the mesh
+    dictionary path): a capacity change between adds kills the cache."""
+    c = _DeviceBatchCache(64)
+    c.add(0, "a", 10, capacity=100)
+    c.add(0, "b", 10, capacity=200)
+    assert not c.alive and not c.entries and c.shared["used"] == 0
+
+
+def test_stale_non_repadable_cache_invalidates_at_replay(rcv1_path):
+    """A staged-vs-live capacity mismatch at the replay entry invalidates
+    a NON-repadable cache (hashed here; the mesh dictionary in
+    production) and training falls back to streaming with the
+    trajectory unchanged."""
+    ref, _ = run_hashed(rcv1_path, device_cache_mb=0, epochs=5)
+
+    def setup(learner):
+        def corrupt(e, t, v):
+            if e == 2:
+                learner._dev_caches[K_TRAINING].capacity += 1
+
+        learner.add_epoch_end_callback(corrupt)
+
+    seen, learner = run_hashed(rcv1_path, device_cache_mb=256, epochs=5,
+                               setup=setup)
+    assert not learner._dev_caches[K_TRAINING].alive
+    np.testing.assert_allclose(seen, ref, rtol=1e-6, atol=1e-6)
