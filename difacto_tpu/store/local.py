@@ -306,7 +306,8 @@ class SlotStore:
     def _sorted_items(self) -> Tuple[np.ndarray, np.ndarray]:
         return self._keys, self._slots
 
-    def _state_np(self, state: SGDState) -> dict:
+    def _state_np(self, state: SGDState,
+                  keys: Optional[Tuple[str, ...]] = None) -> dict:
         """Host view with the logical V/Vg split (state stores fused VVg,
         halves padded to v_half lanes; the split slices back to the
         logical V_dim columns so checkpoints/dumps are pad-free and
@@ -315,15 +316,26 @@ class SlotStore:
         addressable."""
         from ..parallel.multihost import to_local_numpy
         from ..updaters.sgd_updater import col_V, col_Vg, scal_cols
+        # build and fetch ONLY what the caller writes: the device->host
+        # link is the cost (~8 MB/s tunneled; a full 4.2M-row V16 state
+        # is ~600 MB), a non-aux save/dump never touches z/sqrt_g/Vg,
+        # and the V/Vg slices materialize full [capacity, k] copies in
+        # HBM if dispatched (the scal unpack is one pass serving all
+        # five scalar columns, so it always runs)
         w, zz, sg, cnt, live = scal_cols(self.param, state)
-        cols = {"w": w, "z": zz, "sqrt_g": sg, "cnt": cnt, "v_live": live,
-                "V": col_V(self.param, state),
-                "Vg": col_Vg(self.param, state)}
+        cols = {"w": w, "z": zz, "sqrt_g": sg, "cnt": cnt, "v_live": live}
+        if keys is None or "V" in keys:
+            cols["V"] = col_V(self.param, state)
+        if keys is None or "Vg" in keys:
+            cols["Vg"] = col_Vg(self.param, state)
+        if keys is not None:
+            cols = {f: cols[f] for f in keys}
         d = {f: to_local_numpy(a) for f, a in cols.items()}
         # bf16 storage (V_dtype) becomes float32 on the host: numpy/npz
         # have no bfloat16
-        d["V"] = d["V"].astype(np.float32)
-        d["Vg"] = d["Vg"].astype(np.float32)
+        for f in ("V", "Vg"):
+            if f in d:
+                d[f] = d[f].astype(np.float32)
         return d
 
     def _assemble_state(self, arr: dict, capacity: int) -> SGDState:
@@ -355,19 +367,22 @@ class SlotStore:
     def save(self, path: str, save_aux: bool = False) -> int:
         """Checkpoint non-empty entries, sorted by key. Hashed mode has no
         id dictionary — the full dense table is saved instead."""
+        saved = ("w", "cnt", "v_live", "V") + (
+            ("z", "sqrt_g", "Vg") if save_aux else ())
         if self.hashed:
-            st = self._state_np(self.state)
+            st = self._state_np(self.state, keys=saved)
             arrays = dict(hash_capacity=np.array(self.param.hash_capacity),
                           V_dim=np.array(self.param.V_dim),
-                          save_aux=np.array(save_aux), **{
-                              k: st[k] for k in
-                              (("w", "cnt", "v_live", "V") + (
-                                  ("z", "sqrt_g", "Vg") if save_aux
-                                  else ()))})
-            stream.save_npz(path, **arrays)
+                          save_aux=np.array(save_aux),
+                          **{k: st[k] for k in saved})
+            # uncompressed: a trained 4.2M-row V16 state is ~300 MB and
+            # np.savez_compressed writes it at ~6 MB/s — ~50 s added to
+            # every epoch checkpoint (the rec data cache dropped zlib
+            # for the same reason, docs/perf_notes.md streamed regime)
+            stream.save_npz(path, compress=False, **arrays)
             return int((st["w"] != 0).sum())
         keys, slots = self._sorted_items()
-        st = self._state_np(self.state)
+        st = self._state_np(self.state, keys=saved)
         keep = (st["w"][slots] != 0) | (st["cnt"][slots] != 0)
         if self.param.V_dim > 0:
             keep |= st["v_live"][slots]
@@ -384,7 +399,7 @@ class SlotStore:
         if save_aux:
             arrays.update(z=st["z"][slots], sqrt_g=st["sqrt_g"][slots],
                           Vg=st["Vg"][slots])
-        stream.save_npz(path, **arrays)
+        stream.save_npz(path, compress=False, **arrays)
         return len(keys)
 
     def load(self, path: str) -> int:
@@ -403,8 +418,20 @@ class SlotStore:
                     raise ValueError(
                         f"checkpoint V_dim={ck_vdim} != configured "
                         f"V_dim={self.param.V_dim} ({path})")
-                arr = self._state_np(init_state(self.param,
-                                                self.param.hash_capacity))
+                # host-side zeros template — no device round trip: every
+                # key the checkpoint carries overwrites it in full, and
+                # the aux keys a non-aux checkpoint omits (z, sqrt_g, Vg)
+                # are zero at init anyway. (The dictionary load below
+                # keeps the device init_state template: its rows beyond
+                # the checkpoint retain their random V init.)
+                cap, k_dim = self.param.hash_capacity, self.param.V_dim
+                arr = {"w": np.zeros(cap, np.float32),
+                       "z": np.zeros(cap, np.float32),
+                       "sqrt_g": np.zeros(cap, np.float32),
+                       "cnt": np.zeros(cap, np.float32),
+                       "v_live": np.zeros(cap, bool),
+                       "V": np.zeros((cap, k_dim), np.float32),
+                       "Vg": np.zeros((cap, k_dim), np.float32)}
                 for k in ("w", "cnt", "v_live", "V", "z", "sqrt_g", "Vg"):
                     if k in z.files:
                         arr[k] = z[k]
@@ -448,7 +475,8 @@ class SlotStore:
         entries. need_reverse un-reverses ids back to the original space.
         Hashed mode has no id dictionary: the first column is the slot id
         and need_reverse is ignored."""
-        st = self._state_np(self.state)
+        st = self._state_np(self.state, keys=("w", "v_live", "V") + (
+            ("sqrt_g", "z", "Vg") if dump_aux else ()))
         if self.hashed:
             keep = st["w"] != 0
             if self.param.V_dim > 0:  # keep l1-shrunk rows with live V
