@@ -302,11 +302,22 @@ def main() -> None:
                          "virtual CPU mesh checks multi-device)")
     args = ap.parse_args()
 
+    import os
+
+    import jax
+
+    # the environment pins JAX_PLATFORMS=axon at interpreter startup and
+    # the env var is not re-read, so an explicit JAX_PLATFORMS=cpu (the
+    # documented virtual-mesh usage, e.g. --mesh 2x4 with
+    # xla_force_host_platform_device_count=8) needs the config override —
+    # same dance as tests/conftest.py
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
     if args.e2e:
         print(json.dumps(run_e2e(args)))
         return
 
-    import jax
     import jax.numpy as jnp
 
     mesh = None
