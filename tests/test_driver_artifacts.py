@@ -34,6 +34,21 @@ def test_bench_device_mode_smoke():
     assert set(rec) >= {"metric", "value", "unit", "vs_baseline"}
 
 
+def test_bench_mesh_mode_smoke():
+    # --mesh DPxFS runs the same step as a sharded program over a mesh —
+    # on the 8 virtual CPU devices the conftest env provides. Guards the
+    # JAX_PLATFORMS=cpu config override in bench.py: without it the
+    # subprocess binds the pinned device platform (1 device) and dies
+    # with "need 8 devices, have 1".
+    proc = _run([sys.executable, "bench.py", "--device-only",
+                 "--mesh", "2x4", "--steps", "2", "--batch-size", "128",
+                 "--uniq", "256", "--capacity", "1024", "--vdim", "4"])
+    assert proc.returncode == 0, proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["value"] > 0
+    assert "mesh2x4" in rec["metric"]
+
+
 def test_bench_e2e_smoke():
     proc = _run([sys.executable, "bench.py", "--e2e",
                  "--e2e-rows", "2000", "--e2e-batch", "256",
