@@ -302,22 +302,17 @@ def main() -> None:
                          "virtual CPU mesh checks multi-device)")
     args = ap.parse_args()
 
-    import os
-
-    import jax
-
-    # the environment pins JAX_PLATFORMS=axon at interpreter startup and
-    # the env var is not re-read, so an explicit JAX_PLATFORMS=cpu (the
-    # documented virtual-mesh usage, e.g. --mesh 2x4 with
-    # xla_force_host_platform_device_count=8) needs the config override —
-    # same dance as tests/conftest.py
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
-        jax.config.update("jax_platforms", "cpu")
+    # honor an explicit JAX_PLATFORMS=cpu (the documented virtual-mesh
+    # usage, e.g. --mesh 2x4 with 8 forced host devices) before the
+    # first backend touch
+    from difacto_tpu.utils.platform import apply_env_platform
+    apply_env_platform()
 
     if args.e2e:
         print(json.dumps(run_e2e(args)))
         return
 
+    import jax
     import jax.numpy as jnp
 
     mesh = None

@@ -76,6 +76,11 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 1
 
+    # honor an explicit JAX_PLATFORMS=cpu (virtual-mesh runs) before the
+    # first backend touch — multihost initialize below binds devices
+    from .utils.platform import apply_env_platform
+    apply_env_platform()
+
     if "DIFACTO_NPROCS" in os.environ:
         from .parallel.multihost import initialize
         initialize()

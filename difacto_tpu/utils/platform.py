@@ -1,0 +1,26 @@
+"""Platform-override helper for product entry points.
+
+The environment can pin the device platform at interpreter startup
+(JAX_PLATFORMS is read once and not re-read), so an explicit
+``JAX_PLATFORMS=cpu`` — the documented virtual-mesh usage, e.g. an
+8-device CPU mesh via ``--xla_force_host_platform_device_count=8`` —
+needs ``jax.config.update`` to take effect. Entry points call
+:func:`apply_env_platform` before their first backend touch (importing
+jax is fine; only device binding fixes the platform).
+
+The multi-process test workers and tests/conftest.py keep their own
+unconditional two-line preamble instead of importing this: their env
+setup must run before ANY difacto_tpu import, so a helper import there
+would reintroduce the ordering bug it avoids.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_env_platform() -> None:
+    """Honor an explicit ``JAX_PLATFORMS=cpu`` from the environment."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
