@@ -59,6 +59,51 @@ def test_cli_convert_roundtrip(rcv1_path, tmp_path):
     np.testing.assert_allclose(va, vb, rtol=1e-5)
 
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("conf,overrides", [
+    ("local.conf", ["report_interval=0"]),
+    ("fm.conf", ["report_interval=0"]),
+    ("lbfgs.conf", []),   # report_interval is an sgd-family knob
+    ("bcd.conf", []),
+])
+def test_cli_example_confs_train(conf, overrides, monkeypatch, caplog):
+    # every runnable example conf trains end-to-end through the CLI
+    # (epochs capped; fixture paths inside the confs are repo-relative);
+    # a key falling through the whole chain only WARNS in main(), so the
+    # key-rot guard here is the absence of that warning
+    monkeypatch.chdir(REPO)
+    with caplog.at_level("WARNING", logger="difacto_tpu"):
+        assert main([os.path.join(REPO, "examples", conf),
+                     "max_num_epochs=2"] + overrides) == 0
+    rot = [r.message for r in caplog.records
+           if "unknown config key" in r.getMessage()]
+    assert not rot, f"unconsumed keys in examples/{conf}: {rot}"
+
+
+@pytest.mark.parametrize("conf,shrink", [
+    # shrink the tables (last occurrence wins) so the guard doesn't
+    # allocate the confs' production-size state just to check keys
+    ("criteo_hashed.conf", ["hash_capacity=4096", "V_dim=2"]),
+    ("criteo_dict.conf", ["V_dim=2"]),
+])
+def test_cli_example_conf_templates_parse(conf, shrink):
+    # the criteo confs are templates (data_in commented out): guard them
+    # against key rot — every key must be consumed by the learner chain
+    # (an unknown key would survive init as a leftover). Their 2x4 mesh
+    # builds on the 8 virtual devices the conftest provides. The kwargs
+    # go through the same DifactoParam consumption main() applies.
+    from difacto_tpu.__main__ import DifactoParam
+    from difacto_tpu.config import parse_cli_args
+    from difacto_tpu.learners import Learner
+    kwargs = parse_cli_args(
+        [os.path.join(REPO, "examples", conf)] + shrink)
+    param, remain = DifactoParam.init_allow_unknown(kwargs)
+    remain = Learner.create(param.learner).init(remain)
+    assert not remain, f"unknown keys in examples/{conf}: {remain}"
+
+
 def test_cli_bad_task(tmp_path):
     with pytest.raises(ValueError):
         main(["task=nonsense"])
