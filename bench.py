@@ -209,7 +209,8 @@ def run_e2e(args) -> dict:
         conv.run()
         convert_eps = nrows / (_t.perf_counter() - t0)
 
-        def train(cache_mb: int, n_epochs: int):
+        def train(cache_mb: int, n_epochs: int,
+                  producer_mode: str = "thread"):
             learner = Learner.create("sgd")
             learner.init([("data_in", f"{d}/criteo.rec"),
                           ("data_format", "rec"),
@@ -223,13 +224,14 @@ def run_e2e(args) -> dict:
                           ("report_interval", "0"), ("stop_rel_objv", "0"),
                           ("V_dtype", args.vdtype),
                           ("device_cache_mb", str(cache_mb)),
+                          ("producer_mode", producer_mode),
                           ("hash_capacity", str(args.capacity))])
             marks = []
             learner.add_epoch_end_callback(
                 lambda e, t, v: marks.append(_t.perf_counter()))
             learner.run()
             rate = (n_epochs - 1) * nrows / (marks[-1] - marks[0])
-            return rate, learner.device_cache_info()
+            return rate, learner.device_cache_info(), learner.stage_stats()
 
         # the streamed regime has no staging warm-up to amortize, so a
         # shorter window (2 timed epochs) keeps the bench bounded; its
@@ -241,8 +243,14 @@ def run_e2e(args) -> dict:
         # to the ~1.1 GB fused-row table, and the bigger batch halves the
         # per-step dispatch overhead (~1.28M ex/s replay as of round 5;
         # run-to-run spread on the tunneled chip is a few percent)
-        replay, cache_info = train(4096, epochs)
-        streamed, _ = train(0, streamed_epochs)
+        replay, cache_info, _ = train(4096, epochs)
+        # the streamed run drives the requested producer transport
+        # (--producer-mode; auto = process on multi-core hosts) and keeps
+        # the per-stage decomposition so the headline is attributable:
+        # pack/transfer overlapping the device steps shows up as epoch
+        # wall-clock < the serial stage sum
+        streamed, _, streamed_stages = train(
+            0, streamed_epochs, producer_mode=args.producer_mode)
     # a frozen training cache means the "replay" window was a MIXED
     # regime (staged prefix replayed, tail streamed) — label it so the
     # number is never mistaken for full-HBM replay at larger --e2e-rows
@@ -259,6 +267,12 @@ def run_e2e(args) -> dict:
             "value": round(streamed, 1),
             "vs_baseline": round(streamed / REF_PSLITE_32W_EPS, 3),
             "epochs_timed": streamed_epochs - 1,
+            # which producer transport ran, and where the run's seconds
+            # went (whole-run totals incl. epoch 0): a future streamed
+            # regression localizes to pack vs transfer vs step instead
+            # of hiding in the headline (ISSUE 1 satellite)
+            "producer_mode": streamed_stages.pop("producer_mode"),
+            "stages": streamed_stages,
         },
         "config": {"rows": nrows, "batch": args.e2e_batch,
                    "epochs_timed": epochs - 1,
@@ -291,6 +305,11 @@ def main() -> None:
                          "~2 RTT on a tunneled chip) amortizes")
     ap.add_argument("--e2e-batch", type=int, default=65536,
                     help="training batch size for the e2e pipeline run")
+    ap.add_argument("--producer-mode", default="auto",
+                    choices=("auto", "thread", "process"),
+                    help="streamed-regime producer transport: in-process "
+                         "threads or spawn worker processes + shared-"
+                         "memory ring (auto = process when >= 4 cores)")
     ap.add_argument("--profile", metavar="DIR", default="",
                     help="capture a device trace of the timed step window "
                          "into DIR (view with xprof/TensorBoard)")

@@ -1,0 +1,265 @@
+"""Producer-side batch packing, shared by threads AND worker processes.
+
+The hashed-store host pipeline (read -> parse -> localize -> slot-map ->
+panel/COO pack) is stateless, so it can run anywhere: on the learner's
+producer THREADS (data/producer_pool.OrderedProducerPool) or in spawned
+worker PROCESSES (ProcessProducerPool) that ship packed payloads through
+the shared-memory ring (data/shm_ring.py). This module is the single
+definition of that pipeline — extracted from learners/sgd.py so the two
+transports can never diverge on the payload contract (tuple order, shape-
+cap keys, counts-section semantics).
+
+Process workers rebuild the pipeline from a picklable :class:`StreamSpec`
+(``functools.partial(spec_iter, spec)`` is the pool's ``make_iter``); the
+spec carries a snapshot of the consumer's sticky shape caps so workers
+start from the same shape schedule and steady-state epochs keep replaying
+one compiled step per layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from dataclasses import dataclass, field
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class ShapeSchedule:
+    """Per-run sticky shape caps: every batch pads to the largest bucket
+    seen so far for its (job, dim) key, so steady-state epochs replay ONE
+    compiled step instead of re-bucketing per batch (per-batch ``bucket()``
+    put every odd-sized tail in a fresh jit cache entry — ~10 s/compile on
+    a tunneled chip dominated the whole epoch, round-3 verdict #1). A
+    growing batch costs at most log-many recompiles over the run; caps
+    never shrink. Thread-safe: producer threads prepare batches
+    concurrently. ``snapshot``/``absorb`` ship the caps across the process
+    boundary: spawned producer workers seed from the consumer's snapshot,
+    and the consumer absorbs the caps each delivered payload was packed at,
+    so a cap grown in one worker reaches every later epoch's workers."""
+
+    def __init__(self) -> None:
+        self._caps: dict = {}
+        self._lock = threading.Lock()
+
+    def cap(self, key: str, n: int, minimum: int = 8,
+            exact: bool = False) -> int:
+        """``exact`` keeps a plain sticky max instead of bucketing — for
+        dims that are naturally constant (panel width: criteo rows are
+        always 39 wide; bucketing to 48 would inflate every panel cell
+        stream by ~23% and defeat the uniform-reshape fast path)."""
+        from ..ops.batch import bucket
+        with self._lock:
+            c = self._caps.get(key, 0)
+            if n > c or c == 0:
+                # floor degenerate dims like the bucket() it replaces
+                # (bucket(0) == minimum) — empty batches still need
+                # non-zero-sized device shapes
+                c = max(n, 1) if exact else bucket(n, minimum)
+                self._caps[key] = c
+            return c
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._caps)
+
+    def absorb(self, caps: dict) -> None:
+        """Merge already-resolved cap VALUES (no re-bucketing: the values
+        are caps, not raw dims)."""
+        with self._lock:
+            for k, v in caps.items():
+                if v > self._caps.get(k, 0):
+                    self._caps[k] = v
+
+
+@dataclass
+class BlkInfo:
+    """The slice of a RowBlock the consumer's dispatch still needs after
+    the payload is packed (duck-typed for learners' ``blk`` argument):
+    shipping the whole block across the process boundary would re-send
+    the raw CSR arrays the packed payload already encodes."""
+    size: int
+    label: Optional[np.ndarray] = None
+
+
+# ------------------------------------------------------------------ pack
+def pack_payload(shapes: ShapeSchedule, cblk, n_lanes: int,
+                 padded: np.ndarray, b_cap: int, dim_min: int, job: str,
+                 counts=None, stream_chunk: bool = False):
+    """Shared pack tail of all batch-preparation paths (prepare_hashed /
+    prepare_from_uniq / the learner's consumer-side _pack_mapped): panel
+    layout when rows are near-uniform, COO otherwise, shape caps from the
+    sticky schedule. One definition, so the payload contract (tuple
+    order, cap keys) can never diverge between the producer-side and
+    consumer-side packers. ``padded`` is the OOB-padded slot vector (its
+    length IS u_cap); ``cblk.index`` must already address its
+    sorted-unique lanes (host dedup)."""
+    from ..ops.batch import pack_batch, pack_panel, panel_width
+    u_cap = len(padded)
+    width = panel_width(cblk, b_cap)
+    if width is not None:
+        width = shapes.cap(job + ".w", width, exact=True)
+        i32, f32, binary = pack_panel(
+            cblk, n_lanes, padded, b_cap, width, u_cap,
+            counts=counts)
+        if stream_chunk:
+            return ("panel_chunked", i32, f32,
+                    chunk_host(i32, f32, b_cap, width, u_cap, binary),
+                    binary, b_cap, width, u_cap)
+        return ("panel", i32, f32, binary, b_cap, width, u_cap)
+    nnz_cap = shapes.cap(job + ".nnz", cblk.nnz, dim_min)
+    i32, f32, binary = pack_batch(
+        cblk, n_lanes, padded, b_cap, nnz_cap, u_cap,
+        counts=counts)
+    return ("coo", i32, f32, binary, b_cap, nnz_cap, u_cap)
+
+
+def chunk_host(i32: np.ndarray, f32: np.ndarray, b_cap: int,
+               width: int, u_cap: int, binary: bool):
+    """Producer-side chunked-run layout for a packed panel (the host twin
+    of the learner's staging-time device chunker): streamed runs then
+    dispatch the fast chunked step instead of the unsorted scatter.
+    Ragged panels always carry explicit values (zero on pad cells,
+    ops/batch._panel_arrays), so pad tokens contribute nothing through
+    chunk_vals; uniform binary panels have no pad cells."""
+    from ..ops.batch import panel_chunk_tokens_np
+    cells = b_cap * width
+    fv = None if binary else f32[:cells]
+    return panel_chunk_tokens_np(i32[:cells], fv, u_cap, b_cap, width)
+
+
+def prepare_hashed(shapes: ShapeSchedule, hash_capacity: int, blk,
+                   want_counts: bool, fill_counts: bool, dim_min: int,
+                   job: str, b_cap: Optional[int] = None,
+                   stream_chunk: bool = False):
+    """Producer batch preparation for the hashed store: ONE int32
+    np.unique collapses localization (Localizer::Compact), key->slot
+    mapping, and collision dedup, then the batch packs into the
+    two-buffer transfer — panel layout when rows are near-uniform
+    (criteo), COO otherwise. Stateless, so safe off-thread AND
+    off-process. ``b_cap`` pins the row cap; the remaining dims ride the
+    sticky shape schedule keyed by ``job`` so epochs never recompile.
+    ``want_counts`` keeps the packed counts section (and thus the step's
+    jit signature) present for the WHOLE run; ``fill_counts`` (epoch 0
+    only) computes real occurrence counts — later epochs ship an all-zero
+    section, making apply_count a no-op instead of a recompile."""
+    from ..base import reverse_bytes
+    from ..store.local import hash_slots, pad_slots_oob
+
+    tok = hash_slots(reverse_bytes(blk.index), hash_capacity)
+    if fill_counts:
+        slots, inverse, counts = np.unique(
+            tok, return_inverse=True, return_counts=True)
+        counts = counts.astype(np.float32)
+    else:
+        slots, inverse = np.unique(tok, return_inverse=True)
+        counts = np.zeros(0, np.float32) if want_counts else None
+    cblk = dataclasses.replace(blk, index=inverse.astype(np.uint32))
+    n_uniq = len(slots)
+    u_cap = shapes.cap(job + ".u", n_uniq)
+    b_cap = b_cap or shapes.cap(job + ".b", blk.size, dim_min)
+    padded = pad_slots_oob(slots.astype(np.int32), u_cap, hash_capacity)
+    return pack_payload(shapes, cblk, n_uniq, padded, b_cap, dim_min,
+                        job, counts=counts, stream_chunk=stream_chunk)
+
+
+def prepare_from_uniq(shapes: ShapeSchedule, hash_capacity: int, cblk,
+                      uniq, counts, want_counts: bool, fill_counts: bool,
+                      dim_min: int, job: str, b_cap: Optional[int] = None,
+                      stream_chunk: bool = False):
+    """Cached fast path (data/cached.py): the block arrives already
+    localized to ``uniq`` (sorted reversed ids). The slot map + dedup is
+    O(uniq); the O(nnz) index gather through the uniq->slot permutation
+    runs HERE, once, on the producer. Shape caps come from the sticky
+    schedule; the counts section stays present all run (see
+    prepare_hashed)."""
+    from ..store.local import hash_slots, pad_slots_oob
+
+    raw = hash_slots(uniq, hash_capacity)
+    slots, remap = np.unique(raw, return_inverse=True)
+    cblk = dataclasses.replace(
+        cblk, index=remap[cblk.index].astype(np.uint32))
+    n_lanes = len(slots)
+    u_cap = shapes.cap(job + ".u", n_lanes)
+    b_cap = b_cap or shapes.cap(job + ".b", cblk.size, dim_min)
+    scounts = np.zeros(0, np.float32) if want_counts else None
+    if fill_counts and counts is not None:
+        # counts are per uniq lane; aggregate to slot space (colliding
+        # lanes sum, mirroring map_keys_dedup)
+        scounts = np.zeros(u_cap, dtype=np.float32)
+        scounts[:n_lanes] = np.bincount(
+            remap, weights=counts, minlength=n_lanes)
+    padded = pad_slots_oob(slots.astype(np.int32), u_cap, hash_capacity)
+    return pack_payload(shapes, cblk, n_lanes, padded, b_cap, dim_min,
+                        job, counts=scounts, stream_chunk=stream_chunk)
+
+
+# ------------------------------------------------------------------ spec
+@dataclass
+class StreamSpec:
+    """Everything a spawned producer worker needs to rebuild
+    ``make_iter(part)`` for the hashed streamed-training path — plain
+    picklable values only (no learner, no store, no device state)."""
+    parts: Sequence[int]        # logical pool index -> actual part id
+    n_jobs: int
+    host_rank: int
+    num_hosts: int
+    data_in: str
+    data_format: str
+    cached_uri: Optional[str]
+    batch_size: int
+    shuffle: int
+    neg_sampling: float
+    epoch: int
+    hash_capacity: int
+    want_counts: bool
+    fill_counts: bool
+    dim_min: int
+    job: str
+    b_cap: Optional[int]
+    stream_chunk: bool
+    need_label: bool
+    caps: dict = field(default_factory=dict)
+
+
+def spec_iter(spec: StreamSpec, part_i: int) -> Iterator:
+    """The process-mode ``make_iter``: yields the same ("ready", blk_info,
+    payload) items the learner's thread-mode make_iter produces for the
+    hashed fast path, deterministically (seeded per (epoch, part) — the
+    retry/re-issue contract). Heavy imports happen here, in the worker,
+    after its env overrides are applied."""
+    shapes = ShapeSchedule()
+    shapes.absorb(spec.caps)
+    part = spec.parts[part_i]
+    g_idx = spec.host_rank * spec.n_jobs + part
+    g_num = spec.n_jobs * spec.num_hosts
+
+    def info(blk) -> BlkInfo:
+        return BlkInfo(size=blk.size,
+                       label=blk.label if spec.need_label else None)
+
+    if spec.cached_uri is not None:
+        from .cached import CachedBatchReader
+        rdr = CachedBatchReader(
+            spec.cached_uri, g_idx, g_num, spec.batch_size,
+            shuffle=spec.shuffle > 0,
+            neg_sampling=spec.neg_sampling,
+            seed=spec.epoch * max(g_num, 1) + g_idx,
+            need_counts=spec.fill_counts)
+        for sub, uniq, cnts in rdr:
+            yield ("ready", info(sub), prepare_from_uniq(
+                shapes, spec.hash_capacity, sub, uniq, cnts,
+                spec.want_counts, spec.fill_counts, spec.dim_min,
+                spec.job, spec.b_cap, stream_chunk=spec.stream_chunk))
+        return
+    from .batch_reader import BatchReader
+    reader = BatchReader(spec.data_in, spec.data_format, g_idx, g_num,
+                         spec.batch_size, spec.batch_size * spec.shuffle,
+                         spec.neg_sampling,
+                         seed=spec.epoch * max(g_num, 1) + g_idx)
+    for blk in reader:
+        yield ("ready", info(blk), prepare_hashed(
+            shapes, spec.hash_capacity, blk, spec.want_counts,
+            spec.fill_counts, spec.dim_min, spec.job, spec.b_cap,
+            stream_chunk=spec.stream_chunk))

@@ -41,6 +41,8 @@ _make_reader).
 from __future__ import annotations
 
 import itertools
+import os
+import pickle
 import queue
 import threading
 import time
@@ -167,3 +169,365 @@ class OrderedProducerPool:
             self._stop.set()
             for t in self._threads:
                 t.join()
+
+
+# --------------------------------------------------------------------------
+# Process-based producers: the same pool contract, across the GIL boundary.
+# --------------------------------------------------------------------------
+
+def _pp_worker_main(worker_id: int, make_iter_bytes: bytes, ring_desc,
+                    free_q, cmd_q, done_q, stop_ev, env: dict) -> None:
+    """Worker-process entry point (module-level: spawn pickles a reference).
+
+    Runs one part at a time: receives ("part", part, gen, start) commands,
+    resumes ``make_iter(part)`` at item ``start`` (the deterministic-
+    iterator contract shared with OrderedProducerPool), writes each item's
+    arrays into a leased ring slot and reports it on ``done_q``. The env
+    overrides are applied BEFORE unpickling ``make_iter`` — that unpickle
+    is what pulls in the heavy imports (numpy/jax via the packing helpers),
+    so a worker on a TPU host comes up as a CPU-only process instead of
+    fighting the consumer for the chip.
+    """
+    os.environ.update(env or {})
+    import traceback
+
+    from .shm_ring import ShmRing, SlotOverflow
+    make_iter = pickle.loads(make_iter_bytes)
+    ring = ShmRing.attach(ring_desc)
+    try:
+        while not stop_ev.is_set():
+            try:
+                cmd = cmd_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if cmd[0] == "stop":
+                return
+            _, part, gen, start = cmd
+            try:
+                it = itertools.islice(make_iter(part), start, None)
+                n = start
+                while True:
+                    t0 = time.perf_counter()
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        break
+                    pack_dt = time.perf_counter() - t0
+                    slot = None
+                    while not stop_ev.is_set():  # backpressure point
+                        try:
+                            slot = free_q.get(timeout=0.1)
+                            break
+                        except queue.Empty:
+                            continue
+                    if slot is None:
+                        return  # stopping
+                    try:
+                        ring.write(slot, item, part=part, seq=n, gen=gen)
+                        done_q.put(("item", worker_id, part, gen, n, slot,
+                                    None, pack_dt))
+                    except SlotOverflow:
+                        # oversize item: fall back to the pickled channel
+                        # — slower, never wrong. The unused slot rides the
+                        # message for the CONSUMER to release: a worker
+                        # writing to free_q would share that queue's write
+                        # lock with the consumer, and a kill while holding
+                        # it would wedge the consumer's releases.
+                        done_q.put(("ovf", worker_id, part, gen, n, slot,
+                                    pickle.dumps(item), pack_dt))
+                    n += 1
+                if not stop_ev.is_set():
+                    done_q.put(("end", worker_id, part, gen, n))
+            except BaseException:
+                done_q.put(("err", worker_id, part, gen,
+                            traceback.format_exc()))
+    finally:
+        ring.close()
+
+
+class ProcessProducerPool:
+    """OrderedProducerPool's process-based sibling: N ``spawn`` worker
+    PROCESSES run ``make_iter(part)`` and ship finished items through a
+    shared-memory ring (data/shm_ring.py), so the host pipeline genuinely
+    overlaps the consumer's dispatch loop instead of time-slicing the GIL
+    with it.
+
+    Same contract as the thread pool:
+
+    - parts are pulled from a shared :class:`WorkloadPool` and consumed in
+      canonical order (deterministic trajectories);
+    - ``make_iter(part)`` MUST be deterministic AND picklable (a module-
+      level callable or ``functools.partial`` over picklable state):
+      retries and straggler re-issues resume via
+      ``islice(make_iter(part), n_delivered)``;
+    - exactly-once through per-part GENERATIONS: every reassignment bumps
+      the part's generation, deliveries tagged with a stale generation are
+      dropped (their ring slots released), and the new attempt resumes
+      exactly after the items already accepted — a worker killed mid-part
+      (process death = the thread pool's raise) neither duplicates nor
+      skips a batch;
+    - a worker that RAISES re-queues its part via ``pool.reset`` and
+      escalates to the consumer after ``max_retries``; parts stuck on a
+      hung worker are re-issued via ``pool.remove_stragglers`` whenever a
+      worker sits idle.
+
+    Item lifetime: a yielded item's arrays are zero-copy VIEWS into the
+    ring. By default the slot is auto-released when the NEXT item is
+    yielded (items are valid for one iteration). A consumer that stages
+    the arrays asynchronously (the learner's double-buffered device_put)
+    calls :meth:`pop_lease` after each item and releases the lease itself
+    once the transfer has completed.
+
+    All pool/queue state is driven by the single consumer thread inside
+    ``__iter__`` — no internal threads, no cross-thread races.
+    """
+
+    def __init__(self, n_parts: int, make_iter: Callable[[int], Iterator],
+                 n_workers: int = 2, depth: int = 4,
+                 pool: Optional[WorkloadPool] = None, max_retries: int = 1,
+                 slot_bytes: int = 8 << 20, worker_env: Optional[dict] = None,
+                 join_timeout: float = 5.0):
+        import multiprocessing as mp
+
+        from .shm_ring import ShmRing
+        self.n_parts = n_parts
+        self.n_workers = max(1, min(n_workers, n_parts))
+        self.depth = max(2, depth)
+        self.pool = pool or WorkloadPool(WorkloadPoolParam())
+        self.pool.clear()
+        self.pool.add(n_parts)
+        self.max_retries = max_retries
+        self._join_timeout = join_timeout
+        # JAX_PLATFORMS=cpu by default: workers do host work only and must
+        # never bind the accelerator (callers may override/extend)
+        self._env = {"JAX_PLATFORMS": "cpu"}
+        self._env.update(worker_env or {})
+        self._ctx = mp.get_context("spawn")  # JAX state must never fork
+        self._ring = ShmRing(n_slots=self.n_workers * self.depth,
+                             slot_bytes=slot_bytes,
+                             n_queues=self.n_workers, ctx=self._ctx)
+        self._stop_ev = self._ctx.Event()
+        # one done-queue PER worker: queues' write locks are plain (non-
+        # robust) semaphores, so a worker killed mid-put would wedge every
+        # other writer of a shared queue; with per-worker queues a kill
+        # can only wedge the dead worker's own channel — exactly the
+        # failure the liveness check already handles
+        self._done_qs = [self._ctx.Queue() for _ in range(self.n_workers)]
+        self._cmd_qs = [self._ctx.Queue() for _ in range(self.n_workers)]
+        mi_bytes = pickle.dumps(make_iter)
+        self._procs = [
+            self._ctx.Process(
+                target=_pp_worker_main,
+                args=(w, mi_bytes, self._ring.descriptor(),
+                      self._ring.free_qs[w], self._cmd_qs[w],
+                      self._done_qs[w], self._stop_ev, self._env),
+                daemon=True)
+            for w in range(self.n_workers)
+        ]
+        self._last_lease = None
+        self.pack_s = 0.0          # producer-side seconds, summed
+        self.overflow_items = 0    # items that missed the ring (pickled)
+        self._finished = False
+
+    # ------------------------------------------------------------- API
+    def pop_lease(self):
+        """Take ownership of the last yielded item's slot lease (None if
+        that item traveled the pickled fallback channel). The caller must
+        ``release()`` it; un-popped leases auto-release on the next
+        iteration."""
+        lease, self._last_lease = self._last_lease, None
+        return lease
+
+    def __iter__(self) -> Iterator:
+        for p in self._procs:
+            p.start()
+        try:
+            yield from self._consume()
+        finally:
+            self._shutdown()
+
+    # -------------------------------------------------------- consumer
+    def _consume(self) -> Iterator:
+        n = self.n_parts
+        accepted = [0] * n      # items handed to the consumer, per part
+        gen = [0] * n           # current attempt generation, per part
+        complete = [False] * n
+        fail_counts = [0] * n
+        buffers = [[] for _ in range(n)]   # decoded, awaiting consumption
+        errors: dict = {}
+        self._worker_part = [None] * self.n_workers  # (part, gen) | None
+        dead = [False] * self.n_workers
+
+        def feed(w: int) -> None:
+            part = self.pool.get(w)
+            if part == -2:
+                return
+            gen[part] += 1
+            self._worker_part[w] = (part, gen[part])
+            self._cmd_qs[w].put(("part", part, gen[part], accepted[part]))
+
+        def drop(slot: int) -> None:
+            if slot >= 0:
+                self._ring.release(slot)
+
+        def handle(msg) -> None:
+            kind, w, part, g = msg[:4]
+            if kind in ("item", "ovf"):
+                _, _, _, _, seq, slot, blob, pack_dt = msg
+                self.pack_s += pack_dt
+                if kind == "ovf":
+                    # pickled fallback: the leased-but-unused slot comes
+                    # back through the consumer (see _pp_worker_main)
+                    drop(slot)
+                    slot = -1
+                if g != gen[part] or complete[part]:
+                    drop(slot)  # superseded attempt — exactly-once guard
+                    return
+                if slot >= 0:
+                    from .shm_ring import SlotLease
+                    item, _, _, _ = self._ring.read(slot)
+                    lease = SlotLease(self._ring, slot)
+                else:
+                    item, lease = pickle.loads(blob), None
+                    self.overflow_items += 1
+                accepted[part] += 1
+                buffers[part].append((item, lease))
+            elif kind == "end":
+                if g == gen[part]:
+                    complete[part] = True
+                    self.pool.finish(w)
+                self._worker_part[w] = None
+                feed(w)
+            elif kind == "err":
+                tb = msg[4]
+                if g == gen[part]:
+                    fail_counts[part] += 1
+                    if fail_counts[part] > self.max_retries:
+                        errors[part] = RuntimeError(
+                            f"producer worker failed part {part} "
+                            f"{fail_counts[part]}x:\n{tb}")
+                        complete[part] = True
+                        self.pool.finish(w)
+                    else:
+                        self.pool.reset(w)
+                self._worker_part[w] = None
+                feed(w)
+
+        def pump(timeout: float) -> None:
+            got = False
+            for dq in self._done_qs:
+                while True:
+                    try:
+                        msg = dq.get_nowait()
+                    except queue.Empty:
+                        break
+                    got = True
+                    handle(msg)
+            if not got:
+                time.sleep(timeout)
+                self._check_liveness(gen, feed, dead)
+
+        for w in range(self.n_workers):
+            feed(w)
+
+        cur = 0
+        while cur < n:
+            if buffers[cur]:
+                item, lease = buffers[cur].pop(0)
+                if self._last_lease is not None:
+                    # consumer didn't pop the previous lease: items are
+                    # valid for one iteration by default
+                    self._last_lease.release()
+                self._last_lease = lease
+                yield cur, item
+                continue
+            if complete[cur]:
+                if cur in errors:
+                    raise errors[cur]
+                cur += 1
+                continue
+            # idle workers double as the straggler poller (the thread
+            # pool's idle loop); a re-queued part is picked up below
+            idle = [w for w in range(self.n_workers)
+                    if self._worker_part[w] is None and not dead[w]]
+            if idle:
+                self.pool.remove_stragglers()
+                for w in idle:
+                    feed(w)
+            elif not any(wp and wp[0] == cur
+                         for wp in self._worker_part):
+                # the current part lost its worker (death / straggler
+                # re-issue) and every live worker is busy — likely
+                # backpressure-blocked on a future part's full slot
+                # quota. Evict buffered future-part items from their
+                # ring slots (one memcpy each) so a busy worker can
+                # finish its part, go idle, and pick up the re-queued
+                # current part; without this the ring deadlocks.
+                from .shm_ring import materialize_item
+                for pbuf in buffers:
+                    for j, (it_, lease) in enumerate(pbuf):
+                        if lease is not None:
+                            pbuf[j] = (materialize_item(it_), None)
+                            lease.release()
+            pump(timeout=0.1)
+        self._finished = True
+
+    def _check_liveness(self, gen: list, feed, dead: list) -> None:
+        """A worker that died mid-part (killed, OOM) is the process
+        analog of a raising thread: re-queue its part (pool.reset) and
+        bump the generation so any of its in-flight deliveries that
+        arrive later are dropped; the replacement resumes after the
+        items already accepted."""
+        any_alive = False
+        for w, p in enumerate(self._procs):
+            if dead[w]:
+                continue
+            if p.is_alive():
+                any_alive = True
+                continue
+            dead[w] = True
+            wp = self._worker_part[w]
+            self._worker_part[w] = None
+            if wp is not None:
+                part, _ = wp
+                self.pool.reset(w)
+                gen[part] += 1  # invalidate its still-queued deliveries
+        if not any_alive and not self._finished:
+            alive_assignments = [wp for wp in self._worker_part if wp]
+            if self.pool.num_remains() > 0 or alive_assignments:
+                raise RuntimeError(
+                    "all producer worker processes died with parts "
+                    "remaining")
+
+    # -------------------------------------------------------- teardown
+    def _shutdown(self) -> None:
+        if self._last_lease is not None:
+            self._last_lease.release()
+            self._last_lease = None
+        self._stop_ev.set()
+        for q_ in self._cmd_qs:
+            try:
+                q_.put_nowait(("stop",))
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        deadline = time.time() + self._join_timeout
+        for p in self._procs:
+            if p.pid is None:
+                continue
+            p.join(timeout=max(0.1, deadline - time.time()))
+            if p.is_alive():  # pragma: no cover - hung worker
+                p.terminate()
+                p.join(timeout=1.0)
+                if p.is_alive():
+                    p.kill()
+                    p.join(timeout=1.0)
+        # drain pending queue items so their feeder threads release, then
+        # drop the segment — unlink is idempotent and atexit-backed, so
+        # no /dev/shm entry survives any exit path
+        for dq in self._done_qs:
+            try:
+                while True:
+                    dq.get_nowait()
+            except (queue.Empty, ValueError, OSError):
+                pass
+        self._ring.unlink()

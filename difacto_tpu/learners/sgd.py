@@ -196,35 +196,11 @@ class _DeviceBatchCache:
                 yield part, items[i]
 
 
-class _ShapeSchedule:
-    """Per-run sticky shape caps: every batch pads to the largest bucket
-    seen so far for its (job, dim) key, so steady-state epochs replay ONE
-    compiled step instead of re-bucketing per batch (per-batch ``bucket()``
-    put every odd-sized tail in a fresh jit cache entry — ~10 s/compile on
-    a tunneled chip dominated the whole epoch, round-3 verdict #1). A
-    growing batch costs at most log-many recompiles over the run; caps
-    never shrink. Thread-safe: producer threads prepare batches
-    concurrently."""
-
-    def __init__(self) -> None:
-        self._caps: dict = {}
-        self._lock = threading.Lock()
-
-    def cap(self, key: str, n: int, minimum: int = 8,
-            exact: bool = False) -> int:
-        """``exact`` keeps a plain sticky max instead of bucketing — for
-        dims that are naturally constant (panel width: criteo rows are
-        always 39 wide; bucketing to 48 would inflate every panel cell
-        stream by ~23% and defeat the uniform-reshape fast path)."""
-        with self._lock:
-            c = self._caps.get(key, 0)
-            if n > c or c == 0:
-                # floor degenerate dims like the bucket() it replaces
-                # (bucket(0) == minimum) — empty batches still need
-                # non-zero-sized device shapes
-                c = max(n, 1) if exact else bucket(n, minimum)
-                self._caps[key] = c
-            return c
+# the sticky shape-cap schedule lives in data/pack_stream.py now: the
+# process-based producer pipeline snapshots/absorbs it across the spawn
+# boundary, and the packing helpers it governs are shared between the
+# learner's threads and the worker processes
+from ..data.pack_stream import ShapeSchedule as _ShapeSchedule  # noqa: E402
 
 
 @dataclass
@@ -265,6 +241,22 @@ class SGDLearnerParam(Param):
     # per-step training metric: "binned" = O(B) histogram AUC (default),
     # "exact" = argsort AUC, "none". Validation is always exact (step.py).
     train_auc: str = "binned"
+    # streamed-path producer transport: "thread" = in-process producer
+    # threads (OrderedProducerPool), "process" = spawn worker processes
+    # shipping packed batches through a shared-memory ring
+    # (ProcessProducerPool + data/shm_ring.py) so host pack work truly
+    # overlaps the dispatch loop instead of GIL-slicing against it;
+    # "auto" picks process on hosts with >= 4 cores, thread below (the
+    # spawn + ring overhead only pays when cores can actually overlap).
+    # Process mode engages on the hashed-store streamed TRAINING path
+    # while no device cache is staging (staged payloads pin device
+    # buffers; ring slots must recycle) — other paths fall back to
+    # threads. The ring holds num_producers x producer_depth slots.
+    producer_mode: str = "auto"
+    # bytes per ring slot, MB; 0 = auto-size from the packed-batch byte
+    # budget (~batch_size * 320 B, floored at 1 MB). A batch that outgrows
+    # its slot falls back to pickled transport — slower, never wrong.
+    ring_slot_mb: int = 0
     # STREAMED panel training (no replay cache): build the chunked-run
     # backward layout on the producer threads so streamed steps take the
     # fast chunked step instead of the unsorted scatter (39 vs 73 ms at
@@ -339,6 +331,18 @@ class SGDLearner(Learner):
             raise ValueError(
                 f"unknown train_auc {self.param.train_auc!r} "
                 "(expected binned|exact|none)")
+        if self.param.producer_mode not in ("auto", "thread", "process"):
+            raise ValueError(
+                f"unknown producer_mode {self.param.producer_mode!r} "
+                "(expected auto|thread|process)")
+        # streamed-epoch stage decomposition (bench.py e2e.streamed.stages):
+        # pack_s    = producer-side pipeline seconds (threads or processes)
+        # transfer_s= host->device staging of packed buffers
+        # step_s    = step dispatch + the metric-fetch waits where device
+        #             time surfaces
+        self._stage_acc = {"pack_s": 0.0, "transfer_s": 0.0, "step_s": 0.0}
+        self._stage_lock = threading.Lock()
+        self._last_producer_mode = "thread"
         self._shapes = _ShapeSchedule()
         # job types whose data THIS process has fully passed over once —
         # after that the SPMD dictionary exchange ships slots instead of
@@ -1159,83 +1163,24 @@ class SGDLearner(Learner):
                         dim_min: int, job: str,
                         b_cap: Optional[int] = None,
                         stream_chunk: bool = False):
-        """Producer-thread batch preparation for the hashed store: ONE
-        int32 np.unique collapses localization (Localizer::Compact),
-        key->slot mapping, and collision dedup, then the batch packs into
-        the two-buffer transfer — panel layout when rows are near-uniform
-        (criteo), COO otherwise. Stateless, so safe off-thread. ``b_cap``
-        pins the row cap; the remaining dims ride the sticky shape schedule
-        keyed by ``job`` so epochs never recompile. ``want_counts`` keeps
-        the packed counts section (and thus the step's jit signature)
-        present for the WHOLE run; ``fill_counts`` (epoch 0 only) computes
-        real occurrence counts — later epochs ship an all-zero section,
-        making apply_count a no-op instead of a recompile."""
-        from ..base import reverse_bytes
-        from ..store.local import hash_slots, pad_slots_oob
-
-        tok = hash_slots(reverse_bytes(blk.index),
-                         self.store.param.hash_capacity)
-        if fill_counts:
-            slots, inverse, counts = np.unique(
-                tok, return_inverse=True, return_counts=True)
-            counts = counts.astype(np.float32)
-        else:
-            slots, inverse = np.unique(tok, return_inverse=True)
-            counts = np.zeros(0, np.float32) if want_counts else None
-        cblk = dataclasses.replace(blk, index=inverse.astype(np.uint32))
-        n_uniq = len(slots)
-        u_cap = self._shapes.cap(job + ".u", n_uniq)
-        b_cap = b_cap or self._shapes.cap(job + ".b", blk.size, dim_min)
-        padded = pad_slots_oob(slots.astype(np.int32), u_cap,
-                               self.store.param.hash_capacity)
-        return self._pack_payload(cblk, n_uniq, padded, b_cap, dim_min,
-                                  job, counts=counts,
-                                  stream_chunk=stream_chunk)
+        """Producer batch preparation for the hashed store — delegates to
+        the shared pipeline definition (data/pack_stream.prepare_hashed)
+        so the thread and process transports pack identically."""
+        from ..data.pack_stream import prepare_hashed
+        return prepare_hashed(self._shapes, self.store.param.hash_capacity,
+                              blk, want_counts, fill_counts, dim_min, job,
+                              b_cap, stream_chunk=stream_chunk)
 
     def _pack_payload(self, cblk, n_lanes, padded, b_cap, dim_min: int,
                       job: str, counts=None,
                       stream_chunk: bool = False):
-        """Shared pack tail of all three batch-preparation paths
-        (_prepare_hashed / _prepare_from_uniq / _pack_mapped): panel
-        layout when rows are near-uniform, COO otherwise, shape caps
-        from the sticky schedule. One definition, so the payload
-        contract (tuple order, cap keys) can never diverge between the
-        producer-side and consumer-side packers. ``padded`` is the
-        OOB-padded slot vector (its length IS u_cap); ``cblk.index``
-        must already address its sorted-unique lanes (host dedup)."""
-        from ..ops.batch import pack_batch, pack_panel, panel_width
-        u_cap = len(padded)
-        width = panel_width(cblk, b_cap)
-        if width is not None:
-            width = self._shapes.cap(job + ".w", width, exact=True)
-            i32, f32, binary = pack_panel(
-                cblk, n_lanes, padded, b_cap, width, u_cap,
-                counts=counts)
-            if stream_chunk:
-                return ("panel_chunked", i32, f32,
-                        self._chunk_host(i32, f32, b_cap, width, u_cap,
-                                         binary),
-                        binary, b_cap, width, u_cap)
-            return ("panel", i32, f32, binary, b_cap, width, u_cap)
-        nnz_cap = self._shapes.cap(job + ".nnz", cblk.nnz, dim_min)
-        i32, f32, binary = pack_batch(
-            cblk, n_lanes, padded, b_cap, nnz_cap, u_cap,
-            counts=counts)
-        return ("coo", i32, f32, binary, b_cap, nnz_cap, u_cap)
-
-    def _chunk_host(self, i32: np.ndarray, f32: np.ndarray, b_cap: int,
-                    width: int, u_cap: int, binary: bool):
-        """Producer-thread chunked-run layout for a packed panel (the host
-        twin of the staging-time device chunker _panel_chunk_packed):
-        streamed runs then dispatch the fast chunked step instead of the
-        unsorted scatter. Ragged panels always carry explicit values
-        (zero on pad cells, ops/batch._panel_arrays), so pad tokens
-        contribute nothing through chunk_vals; uniform binary panels have
-        no pad cells."""
-        from ..ops.batch import panel_chunk_tokens_np
-        cells = b_cap * width
-        fv = None if binary else f32[:cells]
-        return panel_chunk_tokens_np(i32[:cells], fv, u_cap, b_cap, width)
+        """Shared pack tail (data/pack_stream.pack_payload): one payload
+        contract for producer-side (thread or process) and consumer-side
+        (_pack_mapped) packers."""
+        from ..data.pack_stream import pack_payload
+        return pack_payload(self._shapes, cblk, n_lanes, padded, b_cap,
+                            dim_min, job, counts=counts,
+                            stream_chunk=stream_chunk)
 
     def _prepare_from_uniq(self, cblk, uniq, counts, want_counts: bool,
                            fill_counts: bool, dim_min: int, job: str,
@@ -1252,29 +1197,14 @@ class SGDLearner(Learner):
         (2.57 vs 2.18 s steady epochs on the same data,
         docs/perf_notes.md round-5 "host dedup"); a staged batch pays the
         host gather once and replays the clean layout every epoch.
-        Shape caps come from the sticky schedule; the counts section stays
-        present all run (see _prepare_hashed)."""
-        from ..store.local import hash_slots, pad_slots_oob
-
-        raw = hash_slots(uniq, self.store.param.hash_capacity)
-        slots, remap = np.unique(raw, return_inverse=True)
-        cblk = dataclasses.replace(
-            cblk, index=remap[cblk.index].astype(np.uint32))
-        n_lanes = len(slots)
-        u_cap = self._shapes.cap(job + ".u", n_lanes)
-        b_cap = b_cap or self._shapes.cap(job + ".b", cblk.size, dim_min)
-        scounts = np.zeros(0, np.float32) if want_counts else None
-        if fill_counts and counts is not None:
-            # counts are per uniq lane; aggregate to slot space (colliding
-            # lanes sum, mirroring map_keys_dedup)
-            scounts = np.zeros(u_cap, dtype=np.float32)
-            scounts[:n_lanes] = np.bincount(
-                remap, weights=counts, minlength=n_lanes)
-        padded = pad_slots_oob(slots.astype(np.int32), u_cap,
-                               self.store.param.hash_capacity)
-        return self._pack_payload(cblk, n_lanes, padded, b_cap, dim_min,
-                                  job, counts=scounts,
-                                  stream_chunk=stream_chunk)
+        Delegates to data/pack_stream.prepare_from_uniq (shared with the
+        process workers)."""
+        from ..data.pack_stream import prepare_from_uniq
+        return prepare_from_uniq(self._shapes,
+                                 self.store.param.hash_capacity, cblk,
+                                 uniq, counts, want_counts, fill_counts,
+                                 dim_min, job, b_cap,
+                                 stream_chunk=stream_chunk)
 
     def _cached_uri(self, job_type: int) -> Optional[str]:
         """The pre-localized rec cache uri for this job, or None."""
@@ -1316,7 +1246,9 @@ class SGDLearner(Learner):
             return []
         flat = jnp.stack([s for _, o, a in pending for s in (o, a)]
                          + extra)
-        vals = np.asarray(flat)
+        t0 = time.perf_counter()
+        vals = np.asarray(flat)  # the sync point where device time lands
+        self._add_stage("step_s", time.perf_counter() - t0)
         for i, (nrows, _, _) in enumerate(pending):
             prog.merge(Progress(nrows=nrows, loss=float(vals[2 * i]),
                                 auc=float(vals[2 * i + 1])))
@@ -1387,6 +1319,48 @@ class SGDLearner(Learner):
                 "staged_mb": round(c.used / (1 << 20), 1),
             }
         return out
+
+    # ------------------------------------------------ streamed pipeline
+    def _add_stage(self, key: str, dt: float) -> None:
+        with self._stage_lock:
+            self._stage_acc[key] += dt
+
+    def stage_stats(self) -> dict:
+        """Streamed-epoch stage decomposition accumulated over the run
+        (pack / transfer / step seconds) plus the producer transport that
+        ran — bench.py emits this as ``e2e.streamed.stages`` so a
+        streamed regression localizes to a stage instead of hiding in
+        the headline rate."""
+        with self._stage_lock:
+            out = {k: round(v, 3) for k, v in self._stage_acc.items()}
+        out["producer_mode"] = self._last_producer_mode
+        return out
+
+    def _resolve_producer_mode(self) -> str:
+        """auto -> process once the host has cores to overlap (>= 4);
+        below that the spawn + ring overhead buys nothing a thread
+        doesn't (the 1-CPU measurement in docs/perf_notes.md)."""
+        import os
+        mode = self.param.producer_mode
+        if mode == "auto":
+            mode = "process" if (os.cpu_count() or 1) >= 4 else "thread"
+        return mode
+
+    def _absorb_payload_caps(self, job: str, item) -> None:
+        """Fold the caps a worker-process payload was packed at back into
+        the consumer's sticky schedule, so later epochs' worker snapshots
+        (and any thread-mode fallback) keep the same jit signatures."""
+        if item[0] != "ready":
+            return
+        payload = item[2]
+        if payload[0] == "panel_chunked":
+            b_cap, d2, u_cap = payload[5], payload[6], payload[7]
+            wkey = job + ".w"
+        else:
+            b_cap, d2, u_cap = payload[4], payload[5], payload[6]
+            wkey = job + (".w" if payload[0] == "panel" else ".nnz")
+        self._shapes.absorb({job + ".b": b_cap, wkey: d2,
+                             job + ".u": u_cap})
 
     def _repad_cache(self, cache: _DeviceBatchCache) -> None:
         """Rewrite every staged payload's OOB slot padding for the LIVE
@@ -1686,21 +1660,86 @@ class SGDLearner(Learner):
                     yield ("compact", blk, compact(blk,
                                                    need_counts=push_cnt))
 
-        from ..data.producer_pool import OrderedProducerPool
+        from ..data.producer_pool import (OrderedProducerPool,
+                                          ProcessProducerPool)
         from ..tracker.workload_pool import (WorkloadPool,
                                              WorkloadPoolParam)
         wp = WorkloadPool(WorkloadPoolParam(
             straggler_timeout=p.straggler_timeout))
-        # the pool runs over the parts still streamed this epoch (all of
-        # them, unless a partial cache replayed a prefix above); logical
-        # pool indices map back to actual part ids for reporting/staging
-        pool = OrderedProducerPool(
-            len(stream_parts), lambda i: make_iter(stream_parts[i]),
-            n_workers=n_workers, depth=p.producer_depth, pool=wp)
+        # producer transport for this epoch's streamed parts: worker
+        # PROCESSES + shared-memory ring when the packing is stateless
+        # (hashed fast path), this is a training pass, and no device
+        # cache is staging (staged payloads would pin ring-backed device
+        # buffers forever) — otherwise producer threads. Both transports
+        # share the WorkloadPool contract, canonical consumption order,
+        # and the packing code (data/pack_stream.py).
+        use_process = (self._resolve_producer_mode() == "process"
+                       and is_train and hashed_fast and stream_parts
+                       and (cache is None or not cache.staging))
+        self._last_producer_mode = "process" if use_process else "thread"
+        if use_process:
+            from ..data.pack_stream import StreamSpec, spec_iter
+            import functools
+            spec = StreamSpec(
+                parts=tuple(stream_parts), n_jobs=n_jobs,
+                host_rank=self._host_rank, num_hosts=self._num_hosts,
+                data_in=p.data_in, data_format=p.data_format,
+                cached_uri=cached_uri, batch_size=p.batch_size,
+                shuffle=p.shuffle, neg_sampling=p.neg_sampling,
+                epoch=epoch,
+                hash_capacity=self.store.param.hash_capacity,
+                want_counts=want_counts, fill_counts=push_cnt,
+                dim_min=dim_min, job=job, b_cap=b_cap_train,
+                stream_chunk=stream_chunk, need_label=False,
+                caps=self._shapes.snapshot())
+            slot_mb = p.ring_slot_mb or max(
+                1, (p.batch_size * 320) >> 20)
+            pool = ProcessProducerPool(
+                len(stream_parts), functools.partial(spec_iter, spec),
+                n_workers=n_workers, depth=p.producer_depth, pool=wp,
+                slot_bytes=slot_mb << 20)
+        else:
+            # the pool runs over the parts still streamed this epoch (all
+            # of them, unless a partial cache replayed a prefix above);
+            # logical pool indices map back to actual part ids for
+            # reporting/staging
+
+            def timed_make_iter(i):
+                it = make_iter(stream_parts[i])
+                while True:
+                    t0 = time.perf_counter()
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        self._add_stage("pack_s",
+                                        time.perf_counter() - t0)
+                        return
+                    self._add_stage("pack_s", time.perf_counter() - t0)
+                    yield item
+
+            pool = OrderedProducerPool(
+                len(stream_parts), timed_make_iter,
+                n_workers=n_workers, depth=p.producer_depth, pool=wp)
         pending: list = []
         cur_part = stream_parts[0] if stream_parts else 0
         reports = self._part_reports(job_type)
         before = Progress(nrows=prog.nrows, loss=prog.loss, auc=prog.auc)
+        # process mode: each yielded item's arrays VIEW a ring slot.
+        # Double-buffered staging — hold the newest two leases (batch
+        # k+1 stages while batch k steps) and release a lease only once
+        # the step consuming its views has completed (its objv scalar is
+        # the fence; jnp.asarray may alias aligned host memory on some
+        # backends, so "transfer done" alone is not enough).
+        import collections
+        inflight: "collections.deque" = collections.deque()
+
+        def retire(keep: int) -> None:
+            while len(inflight) > keep:
+                lease, fence = inflight.popleft()
+                if fence is not None:
+                    jax.block_until_ready(fence)
+                lease.release()
+
         for i, item in pool:
             part = stream_parts[i]
             if part != cur_part:
@@ -1711,12 +1750,25 @@ class SGDLearner(Learner):
                     self._report_part(job_type, before, prog)
                     before = Progress(nrows=prog.nrows, loss=prog.loss,
                                       auc=prog.auc)
+            if use_process:
+                self._absorb_payload_caps(job, item)
+            n_before = len(pending)
             self._dispatch_item(job_type, item, push_cnt, want_counts, job,
                                 dim_min, pending, cache=cache, part=cur_part)
+            if use_process:
+                lease = pool.pop_lease()
+                if lease is not None:
+                    fence = (pending[-1][1] if len(pending) > n_before
+                             else None)
+                    inflight.append((lease, fence))
+                    retire(keep=2)
             if len(pending) >= self._MERGE_CAP:
                 self._merge_pending(pending, prog)
                 pending = []
         self._final_merge(job_type, pending, prog)
+        retire(keep=0)
+        if use_process:
+            self._add_stage("pack_s", pool.pack_s)
         self._report_part(job_type, before, prog)
         if cache is not None:
             cache.finish_pass()
@@ -1726,6 +1778,14 @@ class SGDLearner(Learner):
         """Run the fused step on an already-staged packed batch. ``payload``
         = (layout, i32_dev, f32_dev, b_cap, dim2, u_cap, want_counts,
         binary, nrows); dim2 is the panel width or the COO nnz_cap."""
+        t0 = time.perf_counter()
+        try:
+            self._dispatch_packed_inner(job_type, payload, pending, label)
+        finally:
+            self._add_stage("step_s", time.perf_counter() - t0)
+
+    def _dispatch_packed_inner(self, job_type: int, payload, pending: list,
+                               label=None) -> None:
         is_train = job_type == K_TRAINING
         if payload[0] == "devbatch":
             # cached replay of a staged mesh/multi-host global batch
@@ -1889,6 +1949,7 @@ class SGDLearner(Learner):
         """Stage + run one packed-payload batch (both store modes), then
         hand the staged device buffers to the replay cache."""
         is_train = job_type == K_TRAINING
+        t0 = time.perf_counter()
         if payload[0] == "panel_chunked":
             # producer-side chunked layout (stream_chunks): the host
             # sort already ran on the producer thread, so both
@@ -1904,6 +1965,7 @@ class SGDLearner(Learner):
             layout, i32, f32, binary, b_cap, d2, u_cap = payload
             i32, f32 = jnp.asarray(i32), jnp.asarray(f32)
             chunked = False
+        self._add_stage("transfer_s", time.perf_counter() - t0)
         wc = want_counts if is_train else False
         staging = (cache is not None and cache.staging
                    and layout == "panel" and is_train)

@@ -20,7 +20,15 @@ import os
 
 
 def apply_env_platform() -> None:
-    """Honor an explicit ``JAX_PLATFORMS=cpu`` from the environment."""
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    """Honor an explicit ``JAX_PLATFORMS`` value from the environment.
+
+    ANY non-empty value passes through to ``jax.config.update`` — not just
+    ``cpu`` (the round-5 ADVICE finding: the old cpu-only check silently
+    ignored e.g. ``JAX_PLATFORMS=tpu,cpu`` or a vendor platform set after
+    interpreter startup, leaving the process on whatever the env pinned
+    at import time). An empty/unset variable changes nothing: JAX keeps
+    its own default platform selection."""
+    val = os.environ.get("JAX_PLATFORMS", "").strip()
+    if val:
         import jax
-        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_platforms", val.lower())

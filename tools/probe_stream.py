@@ -8,7 +8,10 @@ chip into its pipeline stages:
   host-pack : producer threads parse rec members -> localize -> panel pack
   transfer  : host->device staging of the packed buffers (jnp.asarray)
   step      : the fused train step itself (replay rate, no transfers)
-  streamed  : the full pipeline with device_cache_mb=0
+  streamed  : the full pipeline with device_cache_mb=0, BOTH producer
+              transports (thread vs process + shared-memory ring) with
+              the learner's per-stage decomposition, so the thread-vs-
+              process overlap is measured, not inferred
   replay    : the same run with the cache on (epochs 1+ replay from HBM)
 
 Usage: python tools/probe_stream.py [--rows N] [--vdim K] [--batch B]
@@ -55,7 +58,8 @@ def main() -> None:
                    ("rec_batch_size", str(args.batch))])
         conv.run()
 
-        def make_learner(cache_mb: int) -> Learner:
+        def make_learner(cache_mb: int,
+                         producer_mode: str = "thread") -> Learner:
             ln = Learner.create("sgd")
             ln.init([("data_in", f"{d}/criteo.rec"), ("data_format", "rec"),
                      ("loss", "fm"), ("V_dim", str(args.vdim)),
@@ -66,6 +70,7 @@ def main() -> None:
                      ("report_interval", "0"), ("stop_rel_objv", "0"),
                      ("V_dtype", "bfloat16"),
                      ("device_cache_mb", str(cache_mb)),
+                     ("producer_mode", producer_mode),
                      ("hash_capacity", str(args.capacity))])
             return ln
 
@@ -122,18 +127,26 @@ def main() -> None:
         }
 
         # -------------------------------------------------- streamed e2e
-        ln = make_learner(0)
-        marks = []
-        ln.add_epoch_end_callback(
-            lambda e, t, v: marks.append(time.perf_counter()))
-        t0 = time.perf_counter()
-        ln.run()
-        epochs_s = np.diff([t0] + marks)
-        out["streamed"] = {
-            "epoch_sec": [round(s, 2) for s in epochs_s],
-            "steady_examples_per_sec": round(
-                args.rows / float(np.mean(epochs_s[1:])), 1),
-        }
+        # both producer transports, so the thread-vs-process overlap is a
+        # measured table (docs/perf_notes.md "The streamed regime"), each
+        # with the learner's pack/transfer/step second totals attached
+        def streamed_run(mode: str) -> dict:
+            ln = make_learner(0, producer_mode=mode)
+            marks = []
+            ln.add_epoch_end_callback(
+                lambda e, t, v: marks.append(time.perf_counter()))
+            t0 = time.perf_counter()
+            ln.run()
+            epochs_s = np.diff([t0] + marks)
+            return {
+                "epoch_sec": [round(s, 2) for s in epochs_s],
+                "steady_examples_per_sec": round(
+                    args.rows / float(np.mean(epochs_s[1:])), 1),
+                "stages": ln.stage_stats(),
+            }
+
+        out["streamed"] = streamed_run("thread")
+        out["streamed_process"] = streamed_run("process")
 
         # -------------------------------------------------- replay e2e
         ln2 = make_learner(2048)
