@@ -280,6 +280,81 @@ def run_e2e(args) -> dict:
     }
 
 
+def _gen_serve_rows(n_rows: int, nnz_per_row: int, id_space: int,
+                    seed: int = 0) -> list:
+    """Synthetic libsvm request lines for the serving bench."""
+    rng = np.random.RandomState(seed)
+    rows = []
+    for _ in range(n_rows):
+        ids = np.sort(rng.choice(id_space, nnz_per_row, replace=False))
+        rows.append(("0 " + " ".join(f"{i}:1" for i in ids)).encode())
+    return rows
+
+
+def run_serve_bench(args) -> dict:
+    """serve.* section: online-serving latency/throughput trajectory,
+    tracked like the training numbers. An in-process ServeServer over a
+    synthetic hashed model takes an open-loop Poisson load (tools/
+    loadgen.py) at --serve-qps; a short warmup run compiles the shape
+    buckets first, so ``steady_state_compiles`` reports the acceptance
+    gate directly (0 = every measured dispatch was a bucket hit)."""
+    import os
+    import sys
+
+    from difacto_tpu.serve import ServeServer
+    from difacto_tpu.store.local import SlotStore
+    from difacto_tpu.updaters.sgd_updater import (SGDUpdaterParam,
+                                                  set_all_live)
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from loadgen import run_loadgen
+
+    # l1_shrk off so the all-zero-w synthetic model still exercises the
+    # full [w|V] gather + FM interaction path the real service pays
+    param = SGDUpdaterParam(V_dim=args.serve_vdim, l1_shrk=False,
+                            hash_capacity=args.serve_capacity)
+    store = SlotStore(param, read_only=True)
+    if args.serve_vdim:
+        store.state = set_all_live(param, store.state)
+    rows = _gen_serve_rows(512, args.nnz_per_row, 1 << 17)
+    server = ServeServer(store, batch_size=args.serve_batch,
+                         max_delay_ms=args.serve_delay_ms,
+                         queue_cap=args.serve_queue_cap)
+    server.start()
+    try:
+        # warmup at the TARGET rate: micro-batch occupancy (and so the
+        # sticky shape caps) depends on the arrival rate, so warming at a
+        # lower rate would leave the measured window to pay the compiles
+        run_loadgen(server.host, server.port, rows, qps=args.serve_qps,
+                    duration_s=2.0)
+        before = server.executor.stats()["buckets_compiled"]
+        rep = run_loadgen(server.host, server.port, rows,
+                          qps=args.serve_qps,
+                          duration_s=args.serve_seconds)
+        after = server.executor.stats()["buckets_compiled"]
+        snap = server.stats_snapshot()
+    finally:
+        server.close()
+    return {
+        "p50_ms": rep.get("p50_ms", 0.0),
+        "p95_ms": rep.get("p95_ms", 0.0),
+        "p99_ms": rep.get("p99_ms", 0.0),
+        "qps": rep["achieved_qps"],
+        "shed_rate": rep["shed_rate"],
+        "target_qps": args.serve_qps,
+        "offered_qps": rep["offered_qps"],
+        "batch_occupancy": snap["batch_occupancy"],
+        "steady_state_compiles": after - before,
+        "buckets_compiled": after,
+        "config": {"batch": args.serve_batch,
+                   "max_delay_ms": args.serve_delay_ms,
+                   "queue_cap": args.serve_queue_cap,
+                   "V_dim": args.serve_vdim,
+                   "nnz_per_row": args.nnz_per_row,
+                   "seconds": args.serve_seconds},
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=65536)
@@ -299,6 +374,17 @@ def main() -> None:
                            "step)")
     mode.add_argument("--device-only", action="store_true",
                       help="device step only (skip the e2e pipeline run)")
+    mode.add_argument("--serve", action="store_true",
+                      help="online-serving latency/throughput ONLY: "
+                           "in-process server + open-loop Poisson loadgen")
+    ap.add_argument("--serve-qps", type=float, default=500.0,
+                    help="target offered rate for the serve bench")
+    ap.add_argument("--serve-seconds", type=float, default=5.0)
+    ap.add_argument("--serve-vdim", type=int, default=8)
+    ap.add_argument("--serve-capacity", type=int, default=1 << 16)
+    ap.add_argument("--serve-batch", type=int, default=256)
+    ap.add_argument("--serve-delay-ms", type=float, default=2.0)
+    ap.add_argument("--serve-queue-cap", type=int, default=1024)
     ap.add_argument("--e2e-rows", type=int, default=1_800_000,
                     help="rows in the e2e window; large enough that the "
                          "fixed epoch-boundary cost (final metric fetch, "
@@ -329,6 +415,9 @@ def main() -> None:
 
     if args.e2e:
         print(json.dumps(run_e2e(args)))
+        return
+    if args.serve:
+        print(json.dumps({"serve": run_serve_bench(args)}))
         return
 
     import jax

@@ -9,6 +9,9 @@ config file + CLI overrides into KWArgs, dispatch on ``task``:
   task, main.cc:70-77 sets task=pred and requires model_in).
 - ``dump`` — binary model -> readable TSV (src/reader/dump.h).
 - ``convert`` — data format conversion (src/reader/converter.h).
+- ``serve`` — online inference server over a saved model (serve/: dynamic
+  micro-batching over the bucketed predict executor; no reference analog —
+  the WSDM'16 system trained the models its production stack served).
 
 Unknown leftover keys warn, as in main.cc:40-46.
 """
@@ -29,8 +32,31 @@ log = logging.getLogger("difacto_tpu")
 @dataclass
 class DifactoParam(Param):
     task: str = field(default="train", metadata=dict(
-        enum=["train", "dump", "pred", "convert"]))
+        enum=["train", "dump", "pred", "convert", "serve"]))
     learner: str = "sgd"
+
+
+def _pred_routing_error(learner: str, kwargs: KWArgs) -> ValueError:
+    """task=pred with a non-sgd learner: name the learner that actually
+    produced model_in (from the checkpoint's own meta) and route the user
+    at the tasks that exist, instead of the bare 'only supported by sgd'
+    dead end."""
+    model_in = next((v for k, v in reversed(kwargs) if k == "model_in"), "")
+    produced = ""
+    if model_in:
+        try:
+            from .serve.model import model_meta
+            meta = model_meta(model_in)
+            if meta["learner"]:
+                produced = (f"; model_in={model_in!r} was produced by "
+                            f"learner={meta['learner']!r}")
+        except Exception:  # unreadable/missing model: keep the base message
+            pass
+    return ValueError(
+        f"task=pred runs the bucketed sgd predict executor and is not "
+        f"implemented by learner={learner!r}{produced}. Batch-score sgd "
+        f"models with learner=sgd, or use task=serve for online scoring "
+        f"(docs/serving.md)")
 
 
 @dataclass
@@ -91,10 +117,10 @@ def main(argv: list[str] | None = None) -> int:
     if param.task in ("train", "pred"):
         if param.task == "pred" and param.learner != "sgd":
             # only the sgd learner implements the prediction task (like the
-            # reference, where pred routes through SGDLearner's job types)
-            raise ValueError(
-                f"task=pred is only supported by learner=sgd, "
-                f"not {param.learner!r}")
+            # reference, where pred routes through SGDLearner's job types);
+            # the error names the learner that made the model and points
+            # at the serve path
+            raise _pred_routing_error(param.learner, remain)
         learner = Learner.create(param.learner)
         if param.task == "pred":
             remain.append(("task", "2"))
@@ -109,6 +135,9 @@ def main(argv: list[str] | None = None) -> int:
             # from the last checkpoint (parallel/fault.py)
             log.error("aborting for restart: %s", e)
             return exit_code_for(e.dead)
+    elif param.task == "serve":
+        from .serve import run_serve
+        warn_unknown(run_serve(remain))
     elif param.task == "dump":
         warn_unknown(run_dump(remain))
     elif param.task == "convert":

@@ -490,7 +490,8 @@ class BCDLearner(Learner):
     def save(self, path: str) -> None:
         """(reference BCDUpdater Save/Load are stubs; we persist anyway)"""
         from ..utils import stream
-        stream.save_npz(self._ckpt_path(path), feaids=self.feaids, w=self.w)
+        stream.save_npz(self._ckpt_path(path), feaids=self.feaids, w=self.w,
+                        learner=np.array("bcd"))
 
     def load(self, path: str) -> None:
         from ..utils import stream

@@ -582,7 +582,8 @@ class LBFGSLearner(Learner):
         stream.save_npz(self._ckpt_path(path), feaids=self.feaids,
                         lens=self.lens,
                         weights=np.asarray(self.weights)[:self.N],
-                        V_dim=np.array(self.k))
+                        V_dim=np.array(self.k),
+                        learner=np.array("lbfgs"))
 
     def load(self, path: str) -> None:
         from ..utils import stream

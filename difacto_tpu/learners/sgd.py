@@ -548,19 +548,32 @@ class SGDLearner(Learner):
                 k = resumed + 1
                 log.info("auto-resumed from epoch %d checkpoint", resumed)
         if k == 0 and p.model_in:
+            # prediction never updates the model: load weights-only so a
+            # checkpoint's optimizer state (aux) is skipped entirely
+            # (store/local.py load)
+            wo = p.task == 2
             if p.load_epoch >= 0:
                 log.info("loading model from epoch %d", p.load_epoch)
-                self.store.load(self._model_name(p.model_in, p.load_epoch))
+                self.store.load(self._model_name(p.model_in, p.load_epoch),
+                                weights_only=wo)
                 k = p.load_epoch + 1
             else:
                 log.info("loading latest model...")
-                self.store.load(self._model_name(p.model_in, -1))
+                self.store.load(self._model_name(p.model_in, -1),
+                                weights_only=wo)
 
         if p.task == 2:
             if not p.model_in:
                 raise ValueError("prediction needs model_in")
             prog = Progress()
-            self._run_epoch(k, K_PREDICTION, prog)
+            if self.mesh is None and self._num_hosts == 1:
+                # single-controller batch prediction rides the SAME
+                # bucketed predict executor as task=serve (serve/
+                # executor.py), so offline pred files and online serve
+                # responses are bit-identical for the same rows
+                self._run_pred_executor(prog)
+            else:
+                self._run_epoch(k, K_PREDICTION, prog)
             log.info("prediction: %s", prog.text())
             self.stop()
             return
@@ -1561,6 +1574,34 @@ class SGDLearner(Learner):
         s = getattr(self, "_eval_scalars", None)
         self._eval_scalars = None
         return s if s is not None else self.store.evaluate()
+
+    def _run_pred_executor(self, prog: Progress) -> None:
+        """task=pred through serve's PredictExecutor (ISSUE 2 satellite):
+        slice reader blocks into batch_size windows, score each through
+        the shared bucketed predict program, stream predictions to
+        pred_out with the usual formatting. The executor maps keys with
+        insert=False, so prediction no longer grows the dictionary on
+        unseen validation ids (their contribution is zero either way)."""
+        from ..serve.executor import PredictExecutor
+        p = self.param
+        ex = PredictExecutor(self.store, loss=self.loss)
+        reader = Reader(p.data_val or p.data_in, p.data_format, 0, 1,
+                        chunk_bytes=256 << 20)
+        pending: list = []
+        for blk in reader:
+            s = 0
+            while s < blk.size:
+                e = min(s + p.batch_size, blk.size)
+                sub = blk.slice(s, e)
+                s = e
+                scores, objv, auc = ex.predict(sub)
+                if p.pred_out:
+                    self._save_pred(scores, sub.label)
+                pending.append((sub.size, objv, auc))
+                if len(pending) >= self._MERGE_CAP:
+                    self._merge_pending(pending, prog)
+                    pending = []
+        self._merge_pending(pending, prog)
 
     def _iterate_parts(self, job_type: int, epoch: int, n_jobs: int,
                        prog: Progress) -> None:

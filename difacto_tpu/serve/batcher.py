@@ -1,0 +1,231 @@
+"""Dynamic micro-batcher: amortize device dispatch over many small requests.
+
+The standard adaptive-batching design (Clipper / TF-Serving style): a
+bounded admission queue feeds one batching thread that collects requests
+until ``batch_size`` rows or ``max_delay_ms`` elapse — whichever first —
+then concatenates them into ONE RowBlock and runs the bucketed predict
+executor once. Overload is explicit, never silent: a full queue SHEDS the
+request at admission (``submit`` returns None, the front-end answers
+``!shed``), so queue depth — and therefore worst-case queueing latency —
+stays bounded at ``queue_cap`` rows of work instead of growing without
+limit.
+
+``ServeStats`` is the observability half: per-request latency percentiles
+(p50/p95/p99 over a sliding window), batch occupancy, queue depth and
+shed counters, published through the utils/reporter.py contract (the
+reference's out-of-band progress channel) on a time throttle, and
+snapshot-able on demand (the server's ``#stats`` control line,
+bench.py --serve, tools/loadgen.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+import numpy as np
+import queue
+
+from ..data.rowblock import RowBlock
+from ..utils.reporter import Reporter
+
+log = logging.getLogger("difacto_tpu")
+
+
+class ServeStats:
+    """Thread-safe serving counters + latency window."""
+
+    def __init__(self, reporter: Optional[Reporter] = None,
+                 report_every_s: float = 30.0, window: int = 8192):
+        self._mu = threading.Lock()
+        self._lat = collections.deque(maxlen=window)  # seconds
+        self._t0 = time.monotonic()
+        self._last_report = self._t0
+        self._report_every = report_every_s
+        self.reporter = reporter
+        self.n_requests = 0     # admitted requests (rows)
+        self.n_responses = 0    # scored responses
+        self.n_shed = 0
+        self.n_errors = 0
+        self.n_batches = 0
+        self.rows_batched = 0
+        self.queue_depth = 0    # sampled at each batch flush
+        self.queue_depth_max = 0
+
+    def record_admit(self, rows: int = 1) -> None:
+        with self._mu:
+            self.n_requests += rows
+
+    def record_shed(self, rows: int = 1) -> None:
+        with self._mu:
+            self.n_shed += rows
+
+    def record_error(self, rows: int = 1) -> None:
+        with self._mu:
+            self.n_errors += rows
+
+    def record_batch(self, rows: int, queue_depth: int) -> None:
+        with self._mu:
+            self.n_batches += 1
+            self.rows_batched += rows
+            self.queue_depth = queue_depth
+            self.queue_depth_max = max(self.queue_depth_max, queue_depth)
+
+    def record_latency(self, seconds: float) -> None:
+        with self._mu:
+            self.n_responses += 1
+            self._lat.append(seconds)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            lat = np.asarray(self._lat, dtype=np.float64)
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            offered = self.n_requests + self.n_shed
+            out = {
+                "requests": self.n_requests,
+                "responses": self.n_responses,
+                "shed": self.n_shed,
+                "errors": self.n_errors,
+                "shed_rate": round(self.n_shed / max(offered, 1), 4),
+                "qps": round(self.n_responses / elapsed, 1),
+                "batches": self.n_batches,
+                "batch_occupancy": round(
+                    self.rows_batched / max(self.n_batches, 1), 2),
+                "queue_depth": self.queue_depth,
+                "queue_depth_max": self.queue_depth_max,
+            }
+            if len(lat):
+                p50, p95, p99 = np.percentile(lat, [50, 95, 99]) * 1e3
+                out.update(p50_ms=round(float(p50), 3),
+                           p95_ms=round(float(p95), 3),
+                           p99_ms=round(float(p99), 3),
+                           max_ms=round(float(lat.max() * 1e3), 3))
+        return out
+
+    def maybe_report(self) -> None:
+        """Throttled publish through the Reporter channel — the serving
+        analog of the training progress rows."""
+        if self.reporter is None:
+            return
+        now = time.monotonic()
+        with self._mu:
+            if now - self._last_report < self._report_every:
+                return
+            self._last_report = now
+        self.reporter.report(self.snapshot())
+
+
+class MicroBatcher:
+    """Collect -> concat -> score, with explicit shed on overload.
+
+    ``predict_fn(blk) -> scores[blk.size]`` runs on the single batching
+    thread (the executor's dispatch contract). ``queue_cap`` bounds
+    admission in ROWS of queued work, the quantity that actually sets
+    queueing delay (a row costs what a row costs, however the requests
+    arrive grouped).
+    """
+
+    def __init__(self, predict_fn: Callable[[RowBlock], np.ndarray],
+                 batch_size: int = 256, max_delay_ms: float = 2.0,
+                 queue_cap: int = 1024,
+                 stats: Optional[ServeStats] = None):
+        self.predict_fn = predict_fn
+        self.batch_size = batch_size
+        self.max_delay_s = max_delay_ms / 1e3
+        self.queue_cap = queue_cap
+        self.stats = stats if stats is not None else ServeStats()
+        self._q: "queue.Queue" = queue.Queue()
+        self._rows_queued = 0          # admission-bounded under _mu
+        self._mu = threading.Lock()
+        self._alive = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- control
+    def start(self) -> None:
+        self._alive = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-batcher", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._alive = False
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        # fail any requests still queued so connection writers never hang
+        while True:
+            try:
+                _, fut, _rows = self._q.get_nowait()
+            except queue.Empty:
+                break
+            fut.set_exception(RuntimeError("serve batcher shut down"))
+
+    # ----------------------------------------------------------- submit
+    def submit(self, blk: RowBlock) -> Optional[Future]:
+        """Admit a request (one or more rows). Returns a Future resolving
+        to scores[blk.size], or None when the queue is full — the caller
+        must surface the shed to the client (backpressure is explicit)."""
+        with self._mu:
+            if self._rows_queued + blk.size > self.queue_cap:
+                self.stats.record_shed(blk.size)
+                return None
+            self._rows_queued += blk.size
+        fut: Future = Future()
+        self.stats.record_admit(blk.size)
+        self._q.put((blk, fut, blk.size))
+        return fut
+
+    @property
+    def rows_queued(self) -> int:
+        return self._rows_queued
+
+    # ------------------------------------------------------------- loop
+    def _collect(self):
+        """One micro-batch: block for the first request, then fill until
+        batch_size rows or the delay budget expires."""
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        rows = first[2]
+        deadline = time.monotonic() + self.max_delay_s
+        while rows < self.batch_size:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                item = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            batch.append(item)
+            rows += item[2]
+        return batch
+
+    def _loop(self) -> None:
+        while self._alive:
+            batch = self._collect()
+            if not batch:
+                continue
+            rows = sum(r for _, _, r in batch)
+            with self._mu:
+                self._rows_queued -= rows
+            self.stats.record_batch(rows, self._rows_queued)
+            try:
+                scores = self.predict_fn(
+                    RowBlock.concat([b for b, _, _ in batch]))
+            except Exception as e:  # pragma: no cover - executor bug path
+                log.exception("serve batch failed")
+                self.stats.record_error(rows)
+                for _, fut, _ in batch:
+                    fut.set_exception(e)
+                continue
+            o = 0
+            for b, fut, r in batch:
+                fut.set_result(scores[o:o + r])
+                o += r
+            self.stats.maybe_report()

@@ -1,0 +1,71 @@
+"""Tiny Python client for the serving front-end (serve/server.py).
+
+Speaks the newline protocol: send data rows, read one response line per
+row in order. ``predict`` returns probabilities (or raw margins when the
+server runs pred_prob=false) as floats; shed/error responses surface as
+None entries so callers can retry just those rows.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import List, Optional, Sequence, Union
+
+Line = Union[str, bytes]
+
+
+def _to_bytes(line: Line) -> bytes:
+    b = line.encode() if isinstance(line, str) else line
+    return b if b.endswith(b"\n") else b + b"\n"
+
+
+class ServeClient:
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        try:
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:  # pragma: no cover
+            pass
+        self._rfile = self._sock.makefile("rb")
+
+    # ------------------------------------------------------------- io
+    def score_lines(self, lines: Sequence[Line]) -> List[bytes]:
+        """Pipeline a batch of request rows; returns the raw response
+        line per row (no trailing newline), in request order. For very
+        large batches prefer several calls — the whole request block is
+        written before responses are drained."""
+        payload = b"".join(_to_bytes(l) for l in lines)
+        self._sock.sendall(payload)
+        out = []
+        for _ in range(len(lines)):
+            resp = self._rfile.readline()
+            if not resp:
+                raise ConnectionError("server closed the connection")
+            out.append(resp.rstrip(b"\n"))
+        return out
+
+    def predict(self, lines: Sequence[Line]) -> List[Optional[float]]:
+        """Scores per row; None where the server shed or rejected the
+        row (inspect score_lines for the reason)."""
+        out: List[Optional[float]] = []
+        for resp in self.score_lines(lines):
+            out.append(None if resp.startswith((b"!shed", b"!err"))
+                       else float(resp))
+        return out
+
+    def stats(self) -> dict:
+        """The server's live serving + executor counters (#stats)."""
+        return json.loads(self.score_lines([b"#stats"])[0])
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        finally:
+            self._sock.close()
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,95 @@
+"""Model metadata + serving-store construction.
+
+Every learner stamps its checkpoints with a ``learner`` marker
+(store/local.py save, learners/lbfgs.py, learners/bcd.py); older files
+are sniffed by their key layout. ``model_meta`` resolves the prefix the
+CLI users pass (the sgd learner writes ``<prefix>_part-<rank>``, the
+flat learners ``<prefix>.npz``) to an actual file and reports what
+produced it — the routing information behind the task=pred error message
+(__main__.py) and the task=serve loader below.
+
+``open_serving_store`` is the serving entry: a read-only SlotStore with
+a weights-only load (no optimizer state ever touches host RAM, and
+``push`` raises — store/local.py read_only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional, Tuple
+
+from ..config import KWArgs
+from ..utils import stream
+
+log = logging.getLogger("difacto_tpu")
+
+
+def resolve_model_path(uri: str) -> str:
+    """The actual checkpoint file behind a model prefix: learners append
+    ``_part-<rank>`` (sgd, store/local.py) or ``.npz`` (lbfgs/bcd)."""
+    for cand in (uri, uri + "_part-0", uri + ".npz", uri + "_part-0.npz"):
+        if stream.isfile(cand):
+            return cand
+    raise FileNotFoundError(f"no model file found for {uri!r} "
+                            f"(tried _part-0 / .npz suffixes)")
+
+
+def model_meta(uri: str) -> dict:
+    """{'path', 'learner', 'hashed', 'hash_capacity', 'V_dim', 'save_aux'}
+    for a saved model. ``learner`` comes from the checkpoint's own marker
+    when present, else from the key layout each learner writes; None when
+    the file is not a recognizable difacto model."""
+    path = resolve_model_path(uri)
+    with stream.load_npz(path) as z:
+        files = set(z.files)
+        if "learner" in files:
+            learner: Optional[str] = str(z["learner"])
+        elif "hash_capacity" in files or "keys" in files:
+            learner = "sgd"      # SlotStore layouts (store/local.py save)
+        elif "lens" in files and "weights" in files:
+            learner = "lbfgs"    # learners/lbfgs.py save
+        elif "feaids" in files and "w" in files:
+            learner = "bcd"      # learners/bcd.py save
+        else:
+            learner = None
+        return {
+            "path": path,
+            "learner": learner,
+            "hashed": "hash_capacity" in files,
+            "hash_capacity": (int(z["hash_capacity"])
+                              if "hash_capacity" in files else 0),
+            "V_dim": int(z["V_dim"]) if "V_dim" in files else 0,
+            "save_aux": bool(z["save_aux"]) if "save_aux" in files else False,
+        }
+
+
+def open_serving_store(model_in: str, kwargs: KWArgs = ()
+                       ) -> Tuple["SlotStore", dict, KWArgs]:
+    """Read-only SlotStore loaded weights-only from ``model_in``.
+
+    The store geometry (V_dim, hash_capacity) comes from the checkpoint
+    itself, not the config — a serve process points at a model file and
+    gets the right table without repeating training knobs. Remaining
+    updater keys (V_dtype, l1_shrk, ...) are still consumed from
+    ``kwargs`` so the gather-side semantics can be overridden when
+    needed. Returns (store, meta, leftover kwargs)."""
+    from ..store.local import SlotStore
+    from ..updaters.sgd_updater import SGDUpdaterParam
+
+    meta = model_meta(model_in)
+    if meta["learner"] not in (None, "sgd"):
+        raise ValueError(
+            f"model {model_in!r} was produced by "
+            f"learner={meta['learner']!r}; the serving executor loads sgd "
+            "SlotStore checkpoints only — re-train with learner=sgd to "
+            "serve this data")
+    uparam, remain = SGDUpdaterParam.init_allow_unknown(list(kwargs))
+    uparam = dataclasses.replace(uparam, V_dim=meta["V_dim"],
+                                 hash_capacity=meta["hash_capacity"])
+    store = SlotStore(uparam, read_only=True)
+    n = store.load(meta["path"])
+    log.info("serving store: %s (%s, V_dim=%d, %d non-empty entries, "
+             "weights-only)", meta["path"],
+             "hashed" if meta["hashed"] else "dictionary", meta["V_dim"], n)
+    return store, meta, remain

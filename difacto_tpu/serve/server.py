@@ -1,0 +1,225 @@
+"""Threaded TCP serving front-end.
+
+Wire protocol (newline-delimited, UTF-8/ASCII):
+
+- request line = one data row in the configured ``data_format`` (default
+  libsvm: ``label idx:val idx:val ...`` — the label is ignored for
+  scoring but keeps the row grammar identical to training files);
+- response line = ``%g``-formatted probability (``pred_prob=False``: the
+  raw clamped margin) for that row, in request order per connection;
+- ``#stats`` -> one JSON line of serving + executor counters;
+- ``!shed`` -> the admission queue was full (overload backpressure —
+  resend later or slow down);
+- ``!err <reason>`` -> the row was rejected (malformed, oversized).
+
+One reader + one writer thread per connection: the reader parses and
+admits rows into the shared MicroBatcher, the writer resolves futures in
+request order — so a pipelined client (send N rows, then read N
+responses) never deadlocks against the batching delay. All threads are
+joined on ``close()``; a clean shutdown leaves no threads or sockets
+behind (tests/test_serve.py asserts exactly that).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import socket
+import threading
+import time
+from typing import Optional
+
+from ..data.parsers import get_parser
+from ..utils.reporter import Reporter
+from .batcher import MicroBatcher, ServeStats
+from .executor import PredictExecutor, sigmoid
+
+log = logging.getLogger("difacto_tpu")
+
+
+class ServeServer:
+    def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
+                 loss=None, batch_size: int = 256,
+                 max_delay_ms: float = 2.0, queue_cap: int = 1024,
+                 pred_prob: bool = True, data_format: str = "libsvm",
+                 max_row_nnz: int = 4096, report_every_s: float = 30.0,
+                 reporter: Optional[Reporter] = None):
+        self.executor = PredictExecutor(store, loss=loss)
+        if reporter is None:
+            reporter = Reporter(every=1)
+            reporter.set_monitor(
+                lambda _node, payload: log.info("serve: %s", payload))
+        self.stats = ServeStats(reporter, report_every_s=report_every_s)
+        self.batcher = MicroBatcher(self.executor.predict_scores,
+                                    batch_size=batch_size,
+                                    max_delay_ms=max_delay_ms,
+                                    queue_cap=queue_cap, stats=self.stats)
+        self.pred_prob = pred_prob
+        self.max_row_nnz = max_row_nnz
+        self._parser = get_parser(data_format)
+        self._sock = socket.create_server((host, port))
+        self._sock.settimeout(0.25)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._alive = False
+        self._done = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._conn_threads: list = []
+        self._mu = threading.Lock()
+
+    # ---------------------------------------------------------- control
+    def start(self) -> "ServeServer":
+        self.batcher.start()
+        self._alive = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="serve-accept", daemon=True)
+        self._accept_thread.start()
+        log.info("serving on %s:%d (batch<=%d rows, delay<=%.1fms, "
+                 "queue<=%d rows)", self.host, self.port,
+                 self.batcher.batch_size, self.batcher.max_delay_s * 1e3,
+                 self.batcher.queue_cap)
+        return self
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until close() (or the timeout elapses)."""
+        self._done.wait(timeout)
+
+    def close(self) -> None:
+        """Stop accepting, drain connections, join every thread, unlink
+        the socket — idempotent."""
+        if not self._alive and self._accept_thread is None:
+            return
+        self._alive = False
+        self._done.set()
+        try:
+            self._sock.close()
+        except OSError:  # pragma: no cover
+            pass
+        if self._accept_thread is not None:
+            self._accept_thread.join()
+            self._accept_thread = None
+        with self._mu:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        for t in self._conn_threads:
+            t.join()
+        self._conn_threads.clear()
+        self.batcher.close()
+
+    def stats_snapshot(self) -> dict:
+        """Serving counters + executor bucket stats, one flat dict."""
+        return dict(self.stats.snapshot(), **self.executor.stats())
+
+    # ------------------------------------------------------- connection
+    def _accept_loop(self) -> None:
+        while self._alive:
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.settimeout(None)
+            try:
+                # response lines are tiny; never let Nagle hold them
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:  # pragma: no cover - non-TCP transports
+                pass
+            with self._mu:
+                self._conns.add(conn)
+                # prune finished handler threads so a long-lived server
+                # doesn't accumulate one record per past connection
+                self._conn_threads = [t for t in self._conn_threads
+                                      if t.is_alive()]
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 name="serve-conn", daemon=True)
+            t.start()
+            # append AFTER start: close() joins the accept thread before
+            # walking this list, so it can never see an unstarted thread
+            with self._mu:
+                self._conn_threads.append(t)
+
+    def _handle(self, conn: socket.socket) -> None:
+        """Per-connection reader: parse + admit each line, hand ordered
+        reply slots to the writer thread."""
+        replies: "queue.Queue" = queue.Queue()
+        writer = threading.Thread(target=self._writer,
+                                  args=(conn, replies),
+                                  name="serve-conn-writer", daemon=True)
+        writer.start()
+        try:
+            rfile = conn.makefile("rb")
+            for line in rfile:
+                line = line.strip()
+                if not line:
+                    continue
+                if line.startswith(b"#"):
+                    replies.put(("raw", self._control(line), 0.0))
+                    continue
+                t0 = time.monotonic()
+                try:
+                    blk = self._parser(line)
+                except Exception:
+                    blk = None
+                if blk is None or blk.size != 1:
+                    self.stats.record_error()
+                    replies.put(("raw", b"!err bad row\n", 0.0))
+                    continue
+                if blk.nnz > self.max_row_nnz:
+                    self.stats.record_error()
+                    replies.put((
+                        "raw",
+                        b"!err row exceeds serve_max_row_nnz=%d\n"
+                        % self.max_row_nnz, 0.0))
+                    continue
+                fut = self.batcher.submit(blk)
+                if fut is None:
+                    replies.put(("raw", b"!shed\n", 0.0))
+                else:
+                    replies.put(("fut", fut, t0))
+        except (OSError, ValueError):
+            pass
+        finally:
+            replies.put(None)
+            writer.join()
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._mu:
+                self._conns.discard(conn)
+
+    def _control(self, line: bytes) -> bytes:
+        if line == b"#stats":
+            return (json.dumps(self.stats_snapshot()) + "\n").encode()
+        return b"!err unknown control %s\n" % line[:32]
+
+    def _writer(self, conn: socket.socket, replies: "queue.Queue") -> None:
+        try:
+            while True:
+                item = replies.get()
+                if item is None:
+                    return
+                kind, payload, t0 = item
+                if kind == "raw":
+                    conn.sendall(payload)
+                    continue
+                try:
+                    scores = payload.result(timeout=60.0)
+                except Exception as e:
+                    conn.sendall(b"!err %s\n"
+                                 % str(e).encode("utf-8", "replace")[:200])
+                    continue
+                out = sigmoid(scores) if self.pred_prob else scores
+                # "%g" of the scored row — the SAME formatting
+                # learners/sgd.py _save_pred applies, so serve responses
+                # are byte-identical to task=pred output columns
+                self.stats.record_latency(time.monotonic() - t0)
+                conn.sendall(("%g\n" % float(out[0])).encode())
+        except OSError:  # client went away mid-reply
+            pass

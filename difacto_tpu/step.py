@@ -71,3 +71,25 @@ def make_step_fns(fns, loss: LossSpec, train_auc: str = "binned") -> Tuple:
         return pred, objv, auc
 
     return forward, train_step, eval_step
+
+
+def make_predict_fn(fns, loss: LossSpec):
+    """Predict-only forward over (state, batch, slots) -> (pred, objv, auc).
+
+    The serving subsystem's step (serve/executor.py): identical ops to
+    make_step_fns' eval_step — gather [w, V] rows, loss forward, objective
+    + exact AUC — without building the train step, so a read-only store
+    (no optimizer state) can serve it. Sharing the op sequence is
+    load-bearing: task=pred and task=serve dispatch the SAME program for
+    the same batch shapes, which is what makes their outputs bit-identical
+    (tests/test_serve.py golden test)."""
+
+    def predict_step(state, batch, slots):
+        w, V, vmask = fns.get_rows(state, slots)
+        params = FMParams(w=w, V=V, v_mask=vmask)
+        pred = loss.predict(params, batch)
+        objv = loss.evaluate(pred, batch)
+        auc = auc_times_n_jnp(batch.labels, pred, batch.row_mask)
+        return pred, objv, auc
+
+    return predict_step

@@ -95,10 +95,16 @@ class SlotStore:
     """
 
     def __init__(self, param: SGDUpdaterParam,
-                 initial_capacity: Optional[int] = None, mesh=None):
+                 initial_capacity: Optional[int] = None, mesh=None,
+                 read_only: bool = False):
         self.param = param
         self.fns = make_fns(param)
         self.mesh = mesh
+        # read-only stores serve inference (serve/, task=pred): lookups
+        # never insert into the dictionary, push/apply paths raise, and
+        # load() defaults to a weights-only view that never materializes
+        # optimizer state (z/sqrt_g/Vg) on the host
+        self.read_only = read_only
         # feature dictionary as parallel sorted arrays (id -> slot); bulk
         # lookup/insert is vectorised via searchsorted + merge — the host-side
         # analog of ps-lite's sorted-key requirement (kvstore_dist.h:95).
@@ -142,6 +148,11 @@ class SlotStore:
         plane) that must not swap the table buffers under an in-flight
         step; they call :meth:`grow_to` from the dispatch thread before
         the first step that uses the new slots."""
+        if self.read_only:
+            # serving lookups must not mutate the dictionary: unknown ids
+            # map to TRASH_SLOT (whose row is all-zero, so they contribute
+            # nothing to a prediction)
+            insert = False
         keys = np.asarray(keys, dtype=FEAID_DTYPE)
         if self.hashed:
             return hash_slots(keys, self.param.hash_capacity)
@@ -261,6 +272,11 @@ class SlotStore:
     def push(self, keys: np.ndarray, val_type: int,
              gw: np.ndarray, gV: Optional[np.ndarray] = None,
              vmask: Optional[np.ndarray] = None) -> None:
+        if self.read_only:
+            raise RuntimeError(
+                "push on a read-only store: this SlotStore was opened "
+                "weights-only for inference (serve/task=pred) and carries "
+                "no optimizer state to update")
         slots_np, remap, _ = self.map_keys_dedup(keys)
         if remap is not None:
             # hashed-mode in-batch collisions: sum the colliding values so
@@ -374,6 +390,7 @@ class SlotStore:
             arrays = dict(hash_capacity=np.array(self.param.hash_capacity),
                           V_dim=np.array(self.param.V_dim),
                           save_aux=np.array(save_aux),
+                          learner=np.array("sgd"),
                           **{k: st[k] for k in saved})
             # uncompressed: a trained 4.2M-row V16 state is ~300 MB and
             # np.savez_compressed writes it at ~6 MB/s — ~50 s added to
@@ -395,6 +412,7 @@ class SlotStore:
             V=st["V"][slots],
             save_aux=np.array(save_aux),
             V_dim=np.array(self.param.V_dim),
+            learner=np.array("sgd"),
         )
         if save_aux:
             arrays.update(z=st["z"][slots], sqrt_g=st["sqrt_g"][slots],
@@ -402,7 +420,22 @@ class SlotStore:
         stream.save_npz(path, compress=False, **arrays)
         return len(keys)
 
-    def load(self, path: str) -> int:
+    def load(self, path: str, weights_only: Optional[bool] = None) -> int:
+        """Restore a checkpoint. ``weights_only`` (default: the store's
+        read_only flag) loads just what inference reads — w / cnt /
+        v_live / V — and never materializes optimizer state (z, sqrt_g,
+        Vg) on the host even when the checkpoint carries it: aux columns
+        are stride-0 zero views, so a serving process pays no host RAM
+        for state it will never update."""
+        if weights_only is None:
+            weights_only = self.read_only
+        loaded = (("w", "cnt", "v_live", "V") if weights_only
+                  else ("w", "cnt", "v_live", "V", "z", "sqrt_g", "Vg"))
+
+        def _aux(shape):
+            # stride-0 zeros: a weights-only load allocates no aux memory
+            return np.broadcast_to(np.float32(0.0), shape)
+
         with stream.load_npz(path) as z:
             if self.hashed != ("hash_capacity" in z.files):
                 raise ValueError(
@@ -425,14 +458,16 @@ class SlotStore:
                 # keeps the device init_state template: its rows beyond
                 # the checkpoint retain their random V init.)
                 cap, k_dim = self.param.hash_capacity, self.param.V_dim
+                az = _aux if weights_only else \
+                    (lambda s: np.zeros(s, np.float32))
                 arr = {"w": np.zeros(cap, np.float32),
-                       "z": np.zeros(cap, np.float32),
-                       "sqrt_g": np.zeros(cap, np.float32),
+                       "z": az(cap),
+                       "sqrt_g": az(cap),
                        "cnt": np.zeros(cap, np.float32),
                        "v_live": np.zeros(cap, bool),
                        "V": np.zeros((cap, k_dim), np.float32),
-                       "Vg": np.zeros((cap, k_dim), np.float32)}
-                for k in ("w", "cnt", "v_live", "V", "z", "sqrt_g", "Vg"):
+                       "Vg": az((cap, k_dim))}
+                for k in loaded:
                     if k in z.files:
                         arr[k] = z[k]
                 nnz = int((np.asarray(arr["w"]) != 0).sum())
@@ -453,14 +488,21 @@ class SlotStore:
             while cap < n + 1:
                 cap *= 2
             st = init_state(self.param, cap)
-            arr = {f: a.copy() for f, a in self._state_np(st).items()}
+            if weights_only:
+                arr = {f: a.copy() for f, a in self._state_np(
+                    st, keys=("w", "cnt", "v_live", "V")).items()}
+                arr["z"] = _aux((cap,))
+                arr["sqrt_g"] = _aux((cap,))
+                arr["Vg"] = _aux(arr["V"].shape)
+            else:
+                arr = {f: a.copy() for f, a in self._state_np(st).items()}
             sl = np.arange(1, n + 1)
             arr["w"][sl] = z["w"]
             arr["cnt"][sl] = z["cnt"]
             arr["v_live"][sl] = z["v_live"]
             if z["V"].size:
                 arr["V"][sl] = z["V"]
-            if "z" in z.files:
+            if not weights_only and "z" in z.files:
                 arr["z"][sl] = z["z"]
                 arr["sqrt_g"][sl] = z["sqrt_g"]
                 if z["Vg"].size:

@@ -1,0 +1,288 @@
+"""Online serving subsystem (ISSUE 2): read-only weights-only stores,
+the bucketed predict executor, micro-batching TCP serving, overload
+shedding, and the pred<->serve golden contract.
+
+Every network-bearing test runs under an explicit SIGALRM deadline (the
+test_producer_process.py convention): a wedged server or a lost response
+must fail the suite loudly, not eat the tier-1 timeout.
+"""
+
+import contextlib
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from difacto_tpu.__main__ import main
+
+
+@contextlib.contextmanager
+def deadline(seconds: int):
+    def on_alarm(signum, frame):
+        raise TimeoutError(f"test exceeded {seconds}s deadline")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+@pytest.fixture(scope="module")
+def trained_model(rcv1_path, tmp_path_factory):
+    """A small trained sgd model (dictionary store) + its task=pred
+    output on the same 100 fixture rows."""
+    d = tmp_path_factory.mktemp("serve_model")
+    model = str(d / "model")
+    args = [f"data_in={rcv1_path}", "lr=1", "l1=1", "l2=1",
+            "batch_size=100", "max_num_epochs=3", "shuffle=0",
+            "num_jobs_per_epoch=1", "report_interval=0",
+            f"model_out={model}"]
+    assert main(args) == 0
+    pred_out = str(d / "pred")
+    assert main(args + ["task=pred", f"model_in={model}",
+                        f"data_val={rcv1_path}",
+                        f"pred_out={pred_out}"]) == 0
+    with open(pred_out + "_part-0", "rb") as f:
+        pred_lines = f.read().splitlines()
+    assert len(pred_lines) == 100
+    return {"model": model, "pred_lines": pred_lines}
+
+
+def fixture_rows(rcv1_path):
+    with open(rcv1_path, "rb") as f:
+        return [l for l in f.read().splitlines() if l.strip()]
+
+
+# ----------------------------------------------------- read-only store
+
+def test_read_only_store_weights_only(trained_model):
+    """Satellite: weights-only / read-only load — push raises cleanly,
+    lookups never insert, aux is never materialized, and the served
+    weights equal the fully-loaded ones."""
+    from difacto_tpu.serve import open_serving_store
+    from difacto_tpu.store.local import K_GRADIENT, SlotStore
+    from difacto_tpu.updaters.sgd_updater import SGDUpdaterParam
+
+    store, meta, _ = open_serving_store(trained_model["model"])
+    assert meta["learner"] == "sgd" and store.read_only
+    n_before = store.num_features
+    with pytest.raises(RuntimeError, match="read-only store"):
+        store.push(np.array([1, 2, 3], np.uint64), K_GRADIENT,
+                   np.zeros(3, np.float32))
+    # unknown ids resolve to TRASH without growing the dictionary
+    slots = store.map_keys(np.array([1 << 60, 2 << 60], np.uint64))
+    assert (slots == 0).all()
+    assert store.num_features == n_before
+
+    # weights match a full (aux-bearing) load of the same checkpoint
+    full = SlotStore(SGDUpdaterParam(V_dim=meta["V_dim"]))
+    full.load(meta["path"])
+    keys = full._keys[:16]
+    w_ro, _, _ = store.pull(keys)
+    w_full, _, _ = full.pull(keys)
+    np.testing.assert_array_equal(w_ro, w_full)
+
+
+def test_weights_only_skips_aux(tmp_path):
+    """An aux checkpoint loaded weights-only serves the same weights and
+    never copies z/sqrt_g into the assembled state."""
+    from difacto_tpu.store.local import K_GRADIENT, SlotStore
+    from difacto_tpu.updaters.sgd_updater import SGDUpdaterParam
+
+    param = SGDUpdaterParam(V_dim=0, l1=0.0, lr=1.0, hash_capacity=256)
+    st = SlotStore(param)
+    keys = np.arange(1, 40, dtype=np.uint64)
+    st.push(keys, K_GRADIENT, np.linspace(-1, 1, 39).astype(np.float32))
+    path = str(tmp_path / "ck")
+    st.save(path, save_aux=True)
+
+    ro = SlotStore(param, read_only=True)
+    ro.load(path)  # defaults to weights_only on a read-only store
+    w_ro, _, _ = ro.pull(keys)
+    w_tr, _, _ = st.pull(keys)
+    np.testing.assert_array_equal(w_ro, w_tr)
+    # aux columns of the read-only state are all zero (never loaded)
+    from difacto_tpu.updaters.sgd_updater import scal_cols
+    _, z, sg, _, _ = scal_cols(param, ro.state)
+    assert float(np.abs(np.asarray(z)).sum()) == 0.0
+    assert float(np.abs(np.asarray(sg)).sum()) == 0.0
+
+
+# ------------------------------------------------------- routed errors
+
+def test_pred_routed_error_names_learner(tmp_path):
+    """Satellite: the task=pred learner error names the learner that
+    produced model_in (from the checkpoint meta) and points at
+    task=serve."""
+    model = str(tmp_path / "lbfgs_model.npz")
+    np.savez(model, feaids=np.arange(5, dtype=np.uint64),
+             lens=np.ones(5, np.int64),
+             weights=np.ones(5, np.float32),
+             V_dim=np.array(4), learner=np.array("lbfgs"))
+    with pytest.raises(ValueError) as ei:
+        main(["task=pred", "learner=lbfgs", f"model_in={model}"])
+    msg = str(ei.value)
+    assert "learner='lbfgs'" in msg and "produced by" in msg
+    assert "task=serve" in msg
+
+
+def test_serve_rejects_non_sgd_model(tmp_path):
+    from difacto_tpu.serve import open_serving_store
+    model = str(tmp_path / "bcd_model.npz")
+    np.savez(model, feaids=np.arange(3, dtype=np.uint64),
+             w=np.ones(3, np.float32), learner=np.array("bcd"))
+    with pytest.raises(ValueError, match="learner='bcd'"):
+        open_serving_store(model)
+
+
+# ------------------------------------------------------------ serving
+
+def test_serve_smoke_and_clean_shutdown(trained_model, rcv1_path):
+    """Tier-1 smoke (satellite): ephemeral port, score 100 rows, stats
+    flow, and a clean shutdown that leaves no threads or sockets."""
+    from difacto_tpu.serve import (ServeClient, ServeServer,
+                                   open_serving_store)
+    rows = fixture_rows(rcv1_path)
+    with deadline(120):
+        threads_before = set(threading.enumerate())
+        store, _, _ = open_serving_store(trained_model["model"])
+        # batch_size=100 + generous delay: each pipelined 100-row round
+        # forms ONE deterministic micro-batch, so the steady-state
+        # assertion below is about bucket caching, not arrival timing
+        srv = ServeServer(store, batch_size=100,
+                          max_delay_ms=200.0).start()
+        port = srv.port
+        try:
+            with ServeClient(srv.host, port) as c:
+                resp = c.predict(rows)
+                assert len(resp) == 100
+                assert all(r is not None and 0.0 < r < 1.0 for r in resp)
+                # steady state: scoring the same traffic again compiles
+                # nothing new — every dispatch is a bucket hit
+                st0 = c.stats()
+                c.predict(rows)
+                st1 = c.stats()
+        finally:
+            srv.close()
+            srv.close()  # idempotent
+        assert st1["buckets_compiled"] == st0["buckets_compiled"]
+        assert st1["bucket_hits"] > st0["bucket_hits"]
+        assert st1["responses"] == 200 and st1["shed"] == 0
+        assert st1["p50_ms"] > 0 and st1["p99_ms"] >= st1["p50_ms"]
+        # no serving threads survive close()
+        leftover = [t for t in threading.enumerate()
+                    if t not in threads_before and t.is_alive()]
+        assert not leftover, f"threads leaked: {leftover}"
+        # the listening socket is really gone
+        with pytest.raises(OSError):
+            socket.create_connection(("127.0.0.1", port), timeout=0.5)
+
+
+def test_serve_matches_pred_bit_for_bit(trained_model, rcv1_path):
+    """Golden satellite + acceptance: serve responses are byte-identical
+    to the task=pred output for the same rows (both ride the same
+    bucketed predict executor and the same %g formatting)."""
+    from difacto_tpu.serve import (ServeClient, ServeServer,
+                                   open_serving_store)
+    rows = fixture_rows(rcv1_path)
+    with deadline(120):
+        store, _, _ = open_serving_store(trained_model["model"])
+        # batch_size=100 + generous delay: the pipelined client's 100
+        # rows form ONE micro-batch, the same batch task=pred scored
+        srv = ServeServer(store, batch_size=100,
+                          max_delay_ms=200.0).start()
+        try:
+            with ServeClient(srv.host, srv.port) as c:
+                resp = c.score_lines(rows)
+        finally:
+            srv.close()
+    pred_probs = [l.split(b"\t")[1] for l in trained_model["pred_lines"]]
+    assert resp == pred_probs
+
+
+def test_serve_cli_task(trained_model, rcv1_path, tmp_path):
+    """task=serve end-to-end through the CLI: ready-file handshake,
+    scoring over TCP, bounded lifetime exit."""
+    rows = fixture_rows(rcv1_path)
+    ready = str(tmp_path / "ready")
+    rc = {}
+
+    def run():
+        rc["exit"] = main([
+            "task=serve", f"model_in={trained_model['model']}",
+            "serve_max_seconds=8", f"serve_ready_file={ready}",
+            "serve_batch_size=64"])
+
+    with deadline(120):
+        t = threading.Thread(target=run)
+        t.start()
+        while not os.path.exists(ready):
+            time.sleep(0.02)
+            assert t.is_alive(), "serve CLI exited before listening"
+        host, port = open(ready).read().split()
+        from difacto_tpu.serve import ServeClient
+        with ServeClient(host, int(port)) as c:
+            got = c.predict(rows[:10])
+            assert all(g is not None for g in got)
+            st = c.stats()
+            assert st["responses"] == 10
+        t.join(timeout=60)  # serve_max_seconds bounds the lifetime
+        assert not t.is_alive() and rc["exit"] == 0
+
+
+def test_overload_sheds_and_stays_bounded(trained_model, rcv1_path):
+    """Satellite: open-loop loadgen at ~2x sustainable QPS — the bounded
+    admission queue sheds (non-zero shed count), depth never exceeds the
+    cap, and every request is answered (no deadline-missed hang; the
+    SIGALRM deadline is the hang detector)."""
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    from loadgen import run_loadgen
+
+    from difacto_tpu.serve import ServeServer, open_serving_store
+    rows = fixture_rows(rcv1_path)
+    with deadline(180):
+        store, _, _ = open_serving_store(trained_model["model"])
+        srv = ServeServer(store, batch_size=64, max_delay_ms=2.0,
+                          queue_cap=128)
+        # throttle the executor so "sustainable" is known and small:
+        # <= 64 rows per >= 40 ms batch ~= 1.6k rows/s ceiling
+        real = srv.batcher.predict_fn
+
+        def slow_predict(blk):
+            time.sleep(0.04)
+            return real(blk)
+
+        srv.batcher.predict_fn = slow_predict
+        srv.start()
+        try:
+            # warm the shape buckets off the measured window
+            run_loadgen(srv.host, srv.port, rows, qps=200, duration_s=0.5)
+            rep = run_loadgen(srv.host, srv.port, rows, qps=3200,
+                              duration_s=2.0)
+            snap = srv.stats_snapshot()
+        finally:
+            srv.close()
+    assert rep["shed"] > 0, rep
+    assert rep["ok"] > 0, rep
+    # every sent request was answered — shed fast, never dropped silently
+    assert rep["ok"] + rep["shed"] + rep["err"] == rep["sent"], rep
+    # admission stays bounded at the configured cap
+    assert snap["queue_depth_max"] <= 128, snap
+    assert snap["shed"] == rep["shed"]
+
+
+def test_no_serve_threads_leak_overall():
+    """Whatever ran before this test, no serve threads may survive."""
+    names = [t.name for t in threading.enumerate()
+             if t.name.startswith("serve-")]
+    assert not names, names

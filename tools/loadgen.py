@@ -1,0 +1,168 @@
+"""Open-loop Poisson load generator for the serving front-end.
+
+Open-loop means arrivals follow a fixed schedule (exponential
+inter-arrival gaps at the target QPS) REGARDLESS of response progress —
+the honest way to measure a service under load: a closed loop would slow
+its own offered rate the moment the server slows down and hide the
+queueing collapse (the coordinated-omission trap). A sender thread walks
+the schedule and writes one row per arrival; a receiver thread matches
+responses (in-order per connection) against send timestamps.
+
+Usage:
+
+    python tools/loadgen.py --host 127.0.0.1 --port 9000 \
+        --data tests/data/rcv1_100.libsvm --qps 500 --duration 5
+
+Prints one JSON line: offered/achieved QPS, ok/shed/err counts, and
+p50/p95/p99/max response latency (ms). Importable as ``run_loadgen`` —
+bench.py --serve and tests/test_serve.py drive it in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import threading
+import time
+from typing import List, Sequence, Union
+
+import numpy as np
+
+Line = Union[str, bytes]
+
+
+def _to_bytes(line: Line) -> bytes:
+    b = line.encode() if isinstance(line, str) else line
+    return b if b.endswith(b"\n") else b + b"\n"
+
+
+def run_loadgen(host: str, port: int, rows: Sequence[Line], qps: float,
+                duration_s: float, seed: int = 0,
+                recv_timeout: float = 30.0) -> dict:
+    """Drive the server open-loop at ``qps`` for ``duration_s`` seconds,
+    cycling through ``rows``. Returns the latency/throughput report."""
+    rows = [_to_bytes(r) for r in rows]
+    if not rows:
+        raise ValueError("loadgen needs at least one request row")
+    rng = np.random.RandomState(seed)
+    sock = socket.create_connection((host, port), timeout=recv_timeout)
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:  # pragma: no cover
+        pass
+    rfile = sock.makefile("rb")
+
+    send_ts: List[float] = []      # monotonic send time per request
+    ts_lock = threading.Lock()
+    sent = 0
+
+    def sender() -> None:
+        nonlocal sent
+        t_next = time.monotonic()
+        t_end = t_next + duration_s
+        i = 0
+        while True:
+            now = time.monotonic()
+            if now >= t_end:
+                break
+            if now < t_next:
+                time.sleep(min(t_next - now, 0.01))
+                continue
+            with ts_lock:
+                send_ts.append(time.monotonic())
+            sock.sendall(rows[i % len(rows)])
+            sent += 1
+            i += 1
+            # exponential gaps: Poisson arrivals at the target rate.
+            # Falling behind (a slow send) is NOT forgiven — the next
+            # arrival time advances by the schedule, keeping the offered
+            # rate honest even when the socket pushes back.
+            t_next += rng.exponential(1.0 / qps)
+        # half-close: the server reader sees EOF, drains queued futures,
+        # and the responses for every sent row still arrive below
+        try:
+            sock.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+
+    lat_ok: List[float] = []
+    n_ok = n_shed = n_err = 0
+
+    def receiver() -> None:
+        nonlocal n_ok, n_shed, n_err
+        i = 0
+        while True:
+            try:
+                line = rfile.readline()
+            except (socket.timeout, OSError):
+                break
+            if not line:
+                break
+            now = time.monotonic()
+            with ts_lock:
+                t0 = send_ts[i] if i < len(send_ts) else None
+            i += 1
+            if line.startswith(b"!shed"):
+                n_shed += 1
+            elif line.startswith(b"!err"):
+                n_err += 1
+            else:
+                n_ok += 1
+                if t0 is not None:
+                    lat_ok.append(now - t0)
+
+    st = threading.Thread(target=sender, name="loadgen-send")
+    rt = threading.Thread(target=receiver, name="loadgen-recv")
+    t_start = time.monotonic()
+    st.start()
+    rt.start()
+    st.join()
+    rt.join()
+    elapsed = time.monotonic() - t_start
+    rfile.close()
+    sock.close()
+
+    out = {
+        "target_qps": qps,
+        "duration_s": round(duration_s, 3),
+        "sent": sent,
+        "offered_qps": round(sent / max(duration_s, 1e-9), 1),
+        "ok": n_ok,
+        "shed": n_shed,
+        "err": n_err,
+        "shed_rate": round(n_shed / max(sent, 1), 4),
+        # completed responses over the whole drain window: the rate the
+        # service actually sustained
+        "achieved_qps": round(n_ok / max(elapsed, 1e-9), 1),
+    }
+    if lat_ok:
+        lat = np.asarray(lat_ok) * 1e3
+        p50, p95, p99 = np.percentile(lat, [50, 95, 99])
+        out.update(p50_ms=round(float(p50), 3), p95_ms=round(float(p95), 3),
+                   p99_ms=round(float(p99), 3),
+                   max_ms=round(float(lat.max()), 3))
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--data", required=True,
+                    help="request rows, one per line (e.g. a libsvm file)")
+    ap.add_argument("--qps", type=float, default=500.0)
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--max-rows", type=int, default=100000,
+                    help="cap on distinct rows read from --data")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    with open(args.data, "rb") as f:
+        rows = [l for l in f.read().splitlines() if l.strip()]
+    rows = rows[:args.max_rows]
+    print(json.dumps(run_loadgen(args.host, args.port, rows, args.qps,
+                                 args.duration, seed=args.seed)))
+
+
+if __name__ == "__main__":
+    main()
