@@ -301,7 +301,10 @@ def run_serve_bench(args) -> dict:
     import os
     import sys
 
-    from difacto_tpu.serve import ServeServer
+    import tempfile
+    import time as _time
+
+    from difacto_tpu.serve import ModelReloader, ServeClient, ServeServer
     from difacto_tpu.store.local import SlotStore
     from difacto_tpu.updaters.sgd_updater import (SGDUpdaterParam,
                                                   set_all_live)
@@ -321,6 +324,8 @@ def run_serve_bench(args) -> dict:
                          max_delay_ms=args.serve_delay_ms,
                          queue_cap=args.serve_queue_cap)
     server.start()
+    drain_s = 0.0
+    reload_ms: list = []
     try:
         # warmup at the TARGET rate: micro-batch occupancy (and so the
         # sticky shape caps) depends on the arrival rate, so warming at a
@@ -333,9 +338,30 @@ def run_serve_bench(args) -> dict:
                           duration_s=args.serve_seconds)
         after = server.executor.stats()["buckets_compiled"]
         snap = server.stats_snapshot()
+        # resilience cost (ISSUE 3): hot-reload latency over the wire —
+        # save the serving table as a real checkpoint, then time full
+        # #reload cycles (verify + weights-only load + atomic swap)
+        with tempfile.TemporaryDirectory() as td:
+            model = os.path.join(td, "model")
+            store.save(model)
+            server.reloader = ModelReloader(server.executor, model)
+            with ServeClient(server.host, server.port) as c:
+                for _ in range(5):
+                    store.save(model)  # bump the generation
+                    t0 = _time.monotonic()
+                    res = c.reload()
+                    dt = (_time.monotonic() - t0) * 1e3
+                    if res.get("ok"):
+                        reload_ms.append(dt)
+        # ... and graceful-drain time with the queue already empty (the
+        # floor an orchestrator pays per rotation)
+        drain_s = server.drain()
     finally:
         server.close()
     return {
+        "reload_p99_ms": round(float(np.percentile(reload_ms, 99)), 3)
+        if reload_ms else 0.0,
+        "drain_s": round(drain_s, 3),
         "p50_ms": rep.get("p50_ms", 0.0),
         "p95_ms": rep.get("p95_ms", 0.0),
         "p99_ms": rep.get("p99_ms", 0.0),

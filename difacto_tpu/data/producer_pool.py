@@ -126,6 +126,12 @@ class OrderedProducerPool:
                 my_gen = self._gen[part]
                 start = self._enqueued[part]
             try:
+                # chaos harness (utils/faultinject.py): an injected
+                # ``err`` here rides the exact escalation path a real
+                # parse/read failure takes — re-queue the part, escalate
+                # after max_retries
+                from ..utils import faultinject
+                faultinject.act_default(faultinject.fire("producer.part"))
                 it = itertools.islice(self.make_iter(part), start, None)
                 abandoned = False
                 for item in it:

@@ -489,9 +489,15 @@ class BCDLearner(Learner):
 
     def save(self, path: str) -> None:
         """(reference BCDUpdater Save/Load are stubs; we persist anyway)"""
+        from ..utils import manifest as mft
         from ..utils import stream
-        stream.save_npz(self._ckpt_path(path), feaids=self.feaids, w=self.w,
-                        learner=np.array("bcd"))
+        p = self._ckpt_path(path)
+        stream.save_npz(p, feaids=self.feaids, w=self.w,
+                        learner=np.array("bcd"),
+                        manifest={"learner": "bcd",
+                                  "rows": int(len(self.feaids)),
+                                  "generation": mft.next_generation(p)},
+                        fault_point="ckpt.write")
 
     def load(self, path: str) -> None:
         from ..utils import stream

@@ -578,12 +578,18 @@ class LBFGSLearner(Learner):
     def save(self, path: str) -> None:
         """Flat-model checkpoint (the reference LBFGSUpdater's Save/Load are
         empty stubs, lbfgs_updater.h:22-24; we persist anyway)."""
+        from ..utils import manifest as mft
         from ..utils import stream
-        stream.save_npz(self._ckpt_path(path), feaids=self.feaids,
+        p = self._ckpt_path(path)
+        stream.save_npz(p, feaids=self.feaids,
                         lens=self.lens,
                         weights=np.asarray(self.weights)[:self.N],
                         V_dim=np.array(self.k),
-                        learner=np.array("lbfgs"))
+                        learner=np.array("lbfgs"),
+                        manifest={"learner": "lbfgs",
+                                  "rows": int(len(self.feaids)),
+                                  "generation": mft.next_generation(p)},
+                        fault_point="ckpt.write")
 
     def load(self, path: str) -> None:
         from ..utils import stream

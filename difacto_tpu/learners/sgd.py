@@ -282,6 +282,11 @@ class SGDLearnerParam(Param):
     # saved model after a server loss, SURVEY §5.3).
     ckpt_interval: int = 0
     auto_resume: bool = False
+    # retention for interval checkpoints: keep the newest k generations
+    # (``_iter-*`` files + manifests), prune older ones after each save;
+    # 0 = keep everything. Keep >= 2 so a torn newest generation still
+    # leaves a verified one for auto_resume to walk back to.
+    ckpt_keep: int = 0
     # SPMD mesh (parallel/mesh.py): feature shards ("servers") × data
     # parallelism ("workers"); 1×1 = single device. The reference analog is
     # launch.py's -s/-n server/worker counts.
@@ -605,7 +610,7 @@ class SGDLearner(Learner):
                 # written last (by host 0) so a crash mid-save resumes
                 # from the previous complete epoch
                 self.store.save(self._model_name(p.model_out, k),
-                                save_aux=True)
+                                save_aux=True, epoch=k, keep=p.ckpt_keep)
                 if self._host_rank == 0:
                     self._write_ckpt_meta(k)
 
@@ -659,30 +664,55 @@ class SGDLearner(Learner):
             f.write(json.dumps({"last_epoch": epoch}))
 
     def _try_resume(self) -> Optional[int]:
-        """Load the newest interval checkpoint (ckpt_interval/auto_resume;
-        the recovery leg of parallel/fault.py). Returns the completed epoch
-        or None. A host joining after an eviction may not have written the
-        part file itself — any rank's part works, because the store state
-        is host-complete in both modes (table replicated over dp; the
-        dictionary replicas are bit-identical by construction,
-        multihost.py)."""
-        import json
+        """Load the newest interval checkpoint THAT VERIFIES
+        (ckpt_interval/auto_resume; the recovery leg of parallel/fault.py).
+        Returns the completed epoch or None.
 
+        Candidates come from the meta marker AND a direct ``_iter-*``
+        scan — a crash mid-checkpoint can leave a torn part behind the
+        meta epoch (meta written last) or a meta pointing at bytes that
+        never finished. Each candidate is manifest-verified
+        (require_manifest: every checkpoint this code writes has one, so
+        a missing sidecar means a torn save); corrupt generations are
+        logged and skipped, walking back to the newest good one instead
+        of crashing. A host joining after an eviction may not have
+        written the part file itself — any rank's part works, because
+        the store state is host-complete in both modes (table replicated
+        over dp; the dictionary replicas are bit-identical by
+        construction, multihost.py)."""
+        import json
+        import re
+
+        from ..store.local import CheckpointCorrupt
+        from ..utils import manifest as mft
         from ..utils import stream
+        epochs = set()
         try:
             with stream.open_stream(self._meta_path(), "r") as f:
-                epoch = int(json.loads(f.read())["last_epoch"])
+                epochs.add(int(json.loads(f.read())["last_epoch"]))
         except (FileNotFoundError, OSError, ValueError, KeyError):
-            return None
-        base = self.param.model_out + f"_iter-{epoch}_part-"
-        for rank in [self._host_rank] + list(range(self._num_hosts + 8)):
-            try:
-                self.store.load(base + str(rank))
-                return epoch
-            except (FileNotFoundError, OSError):
+            pass
+        for path in stream.glob(self.param.model_out + "_iter-*_part-*"):
+            if path.endswith(mft.MANIFEST_SUFFIX):
                 continue
-        log.warning("checkpoint meta found but no loadable part for "
-                    "epoch %d; starting fresh", epoch)
+            m = re.search(r"_iter-(\d+)_part-", path)
+            if m:
+                epochs.add(int(m.group(1)))
+        for epoch in sorted(epochs, reverse=True):
+            base = self.param.model_out + f"_iter-{epoch}_part-"
+            for rank in [self._host_rank] + list(range(self._num_hosts + 8)):
+                try:
+                    self.store.load(base + str(rank),
+                                    require_manifest=True)
+                    return epoch
+                except (FileNotFoundError, OSError):
+                    continue
+                except CheckpointCorrupt as e:
+                    log.warning("auto_resume: %s; walking back", e)
+                    continue
+        if epochs:
+            log.warning("checkpoint meta/parts found but no generation "
+                        "verified; starting fresh")
         return None
 
     def _run_epoch(self, epoch: int, job_type: int, prog: Progress) -> None:

@@ -1,15 +1,21 @@
-"""Online serving subsystem: low-latency batched inference (ISSUE 2).
+"""Online serving subsystem: low-latency batched inference (ISSUE 2)
+with a resilient model lifecycle (ISSUE 3).
 
 The missing vertical between "trains the model" and the north star's
 "serves heavy traffic": load a trained model weights-only into a
-read-only SlotStore (model.py), score through a small set of pre-jitted
-shape-bucketed predict programs (executor.py — zero steady-state
-recompiles), amortize accelerator dispatch over many small requests with
-a dynamic micro-batcher (batcher.py — bounded queue, explicit shed on
-overload), and speak newline-delimited data rows over threaded TCP
-(server.py, client.py). ``task=serve`` (__main__.py) is the CLI entry;
+read-only SlotStore (model.py — manifest-verified, walking back to the
+newest good generation if the latest is torn), score through a small set
+of pre-jitted shape-bucketed predict programs (executor.py — zero
+steady-state recompiles), amortize accelerator dispatch over many small
+requests with a dynamic micro-batcher (batcher.py — bounded queue,
+explicit shed on overload), and speak newline-delimited data rows over
+threaded TCP (server.py, client.py — retrying, with `#health` /
+`#reload` control lines). Hot-reload swaps a newly-trained model in
+without a restart (reload.py); SIGTERM drains admitted work and exits 0
+(server.py drain). ``task=serve`` (__main__.py) is the CLI entry;
 tools/loadgen.py drives it open-loop; bench.py --serve tracks the
-latency/throughput trajectory.
+latency/throughput/resilience trajectory; tests/test_chaos.py proves the
+failure paths under injected faults (utils/faultinject.py).
 """
 
 from __future__ import annotations
@@ -18,10 +24,12 @@ import logging
 from dataclasses import dataclass, field
 
 from ..config import KWArgs, Param
+from ..utils.manifest import CheckpointCorrupt
 from .batcher import MicroBatcher, ServeStats
 from .client import ServeClient
 from .executor import PredictExecutor, sigmoid
 from .model import model_meta, open_serving_store, resolve_model_path
+from .reload import ModelReloader
 from .server import ServeServer
 
 log = logging.getLogger("difacto_tpu")
@@ -48,13 +56,28 @@ class ServeParam(Param):
     serve_max_seconds: float = 0.0
     # write "host port\n" here once listening (scripts/tests poll it)
     serve_ready_file: str = ""
+    # graceful shutdown: on SIGTERM/SIGINT stop accepting, answer new
+    # rows "!shed draining", wait this long for admitted work to
+    # resolve, then exit 0 (serve/server.py drain)
+    serve_drain_timeout_s: float = field(default=10.0, metadata=dict(lo=0))
+    # hot-reload watcher: poll model_in every this many seconds and swap
+    # a new generation in without a restart (0 = off; `#reload` over the
+    # wire works either way — serve/reload.py)
+    serve_reload_poll_s: float = field(default=0.0, metadata=dict(lo=0))
     data_format: str = "libsvm"
     pred_prob: bool = True
 
 
 def run_serve(kwargs: KWArgs) -> KWArgs:
     """CLI entry for task=serve (__main__.py): build the read-only store
-    from the model file's own metadata, start the server, block."""
+    from the model file's own metadata (walking back to the newest
+    generation that verifies if the latest is torn), start the server
+    with the hot-reload and drain machinery attached, block. SIGTERM and
+    SIGINT trigger a graceful drain and a zero exit so orchestrators see
+    a clean rotation, not a crash."""
+    import signal
+    import threading
+
     param, remain = ServeParam.init_allow_unknown(kwargs)
     if not param.model_in:
         raise ValueError("please set model_in")
@@ -66,8 +89,22 @@ def run_serve(kwargs: KWArgs) -> KWArgs:
         queue_cap=param.serve_queue_cap,
         pred_prob=param.pred_prob, data_format=param.data_format,
         max_row_nnz=param.serve_max_row_nnz,
-        report_every_s=param.serve_report_every)
+        report_every_s=param.serve_report_every,
+        drain_timeout_s=param.serve_drain_timeout_s)
+    reloader = ModelReloader(server.executor, param.model_in,
+                             poll_s=param.serve_reload_poll_s)
+    server.reloader = reloader
+    # signal.signal only works on the main thread; tests drive run_serve
+    # from worker threads and manage shutdown themselves
+    if threading.current_thread() is threading.main_thread():
+        def _graceful(signum, _frame):
+            log.info("signal %d: draining (timeout %.1fs)", signum,
+                     param.serve_drain_timeout_s)
+            server.drain()
+        signal.signal(signal.SIGTERM, _graceful)
+        signal.signal(signal.SIGINT, _graceful)
     server.start()
+    reloader.start()
     if param.serve_ready_file:
         from ..utils import stream
         with stream.open_stream(param.serve_ready_file, "w") as f:
@@ -77,6 +114,7 @@ def run_serve(kwargs: KWArgs) -> KWArgs:
     except KeyboardInterrupt:  # pragma: no cover - interactive exit
         log.info("interrupted; shutting down")
     finally:
+        reloader.close()
         server.close()
         log.info("serve done: %s", server.stats_snapshot())
     return remain
@@ -84,4 +122,5 @@ def run_serve(kwargs: KWArgs) -> KWArgs:
 
 __all__ = ["ServeParam", "run_serve", "ServeServer", "ServeClient",
            "PredictExecutor", "MicroBatcher", "ServeStats", "sigmoid",
-           "model_meta", "open_serving_store", "resolve_model_path"]
+           "model_meta", "open_serving_store", "resolve_model_path",
+           "ModelReloader", "CheckpointCorrupt"]

@@ -31,6 +31,7 @@ import numpy as np
 import queue
 
 from ..data.rowblock import RowBlock
+from ..utils import faultinject
 from ..utils.reporter import Reporter
 
 log = logging.getLogger("difacto_tpu")
@@ -142,6 +143,7 @@ class MicroBatcher:
         self._rows_queued = 0          # admission-bounded under _mu
         self._mu = threading.Lock()
         self._alive = False
+        self._busy = False             # a batch is being scored right now
         self._thread: Optional[threading.Thread] = None
 
     # ---------------------------------------------------------- control
@@ -168,7 +170,11 @@ class MicroBatcher:
     def submit(self, blk: RowBlock) -> Optional[Future]:
         """Admit a request (one or more rows). Returns a Future resolving
         to scores[blk.size], or None when the queue is full — the caller
-        must surface the shed to the client (backpressure is explicit)."""
+        must surface the shed to the client (backpressure is explicit).
+        ``batcher.enqueue`` is a chaos-harness injection point
+        (utils/faultinject.py): ``err`` surfaces through the server as an
+        ``!err`` reply, ``delay_ms`` models a stalled admission path."""
+        faultinject.act_default(faultinject.fire("batcher.enqueue"))
         with self._mu:
             if self._rows_queued + blk.size > self.queue_cap:
                 self.stats.record_shed(blk.size)
@@ -182,6 +188,12 @@ class MicroBatcher:
     @property
     def rows_queued(self) -> int:
         return self._rows_queued
+
+    @property
+    def idle(self) -> bool:
+        """No queued rows and no batch mid-score — the drain loop's
+        "all admitted work has resolved" condition (server.drain)."""
+        return self._rows_queued == 0 and not self._busy
 
     # ------------------------------------------------------------- loop
     def _collect(self):
@@ -211,21 +223,28 @@ class MicroBatcher:
             batch = self._collect()
             if not batch:
                 continue
-            rows = sum(r for _, _, r in batch)
-            with self._mu:
-                self._rows_queued -= rows
-            self.stats.record_batch(rows, self._rows_queued)
+            # busy BEFORE the queued-row decrement: the drain loop must
+            # never observe (rows_queued == 0, busy == False) while this
+            # batch is still unscored
+            self._busy = True
             try:
-                scores = self.predict_fn(
-                    RowBlock.concat([b for b, _, _ in batch]))
-            except Exception as e:  # pragma: no cover - executor bug path
-                log.exception("serve batch failed")
-                self.stats.record_error(rows)
-                for _, fut, _ in batch:
-                    fut.set_exception(e)
-                continue
-            o = 0
-            for b, fut, r in batch:
-                fut.set_result(scores[o:o + r])
-                o += r
-            self.stats.maybe_report()
+                rows = sum(r for _, _, r in batch)
+                with self._mu:
+                    self._rows_queued -= rows
+                self.stats.record_batch(rows, self._rows_queued)
+                try:
+                    scores = self.predict_fn(
+                        RowBlock.concat([b for b, _, _ in batch]))
+                except Exception as e:  # pragma: no cover - executor bug
+                    log.exception("serve batch failed")
+                    self.stats.record_error(rows)
+                    for _, fut, _ in batch:
+                        fut.set_exception(e)
+                    continue
+                o = 0
+                for b, fut, r in batch:
+                    fut.set_result(scores[o:o + r])
+                    o += r
+                self.stats.maybe_report()
+            finally:
+                self._busy = False

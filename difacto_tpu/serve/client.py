@@ -1,15 +1,34 @@
-"""Tiny Python client for the serving front-end (serve/server.py).
+"""Python client for the serving front-end (serve/server.py), with
+transparent retry.
 
 Speaks the newline protocol: send data rows, read one response line per
 row in order. ``predict`` returns probabilities (or raw margins when the
-server runs pred_prob=false) as floats; shed/error responses surface as
-None entries so callers can retry just those rows.
+server runs pred_prob=false) as floats.
+
+Resilience contract (the client half of the serve lifecycle):
+
+- **connect/read failures retry** with capped exponential backoff + full
+  jitter, up to ``retries`` reconnect attempts per call and never past
+  the per-call ``deadline_s``. Responses arrive in request order, so on a
+  dropped connection the client knows exactly which rows were answered
+  and resends only the tail (scoring is pure — a row scored twice
+  server-side is harmless).
+- ``!shed`` (queue full, or a draining replica) is **retryable**: the
+  server explicitly asked for the row again later, so ``predict`` backs
+  off and resends just the shed rows within the same budget.
+- ``!err`` (malformed row, oversized row, executor error) is **not
+  retryable**: the same bytes would fail the same way; it surfaces as
+  None immediately.
+
+``retries=0`` (default) keeps the old fail-fast behavior byte-for-byte.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import socket
+import time
 from typing import List, Optional, Sequence, Union
 
 Line = Union[str, bytes]
@@ -21,48 +40,158 @@ def _to_bytes(line: Line) -> bytes:
 
 
 class ServeClient:
-    def __init__(self, host: str, port: int, timeout: float = 60.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        try:
-            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        except OSError:  # pragma: no cover
-            pass
-        self._rfile = self._sock.makefile("rb")
+    def __init__(self, host: str, port: int, timeout: float = 60.0,
+                 retries: int = 0, backoff_s: float = 0.05,
+                 backoff_max_s: float = 2.0,
+                 deadline_s: Optional[float] = None):
+        self.host, self.port = host, port
+        self.timeout = timeout
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.backoff_max_s = backoff_max_s
+        self.deadline_s = deadline_s
+        self._rng = random.Random(0x5E12E)
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        # constructor connect honors the same retry budget: a client
+        # racing a replica restart should wait for it, not crash
+        self._ensure_conn(self._deadline())
+
+    # ------------------------------------------------------------- conn
+    def _deadline(self) -> Optional[float]:
+        return (time.monotonic() + self.deadline_s
+                if self.deadline_s is not None else None)
+
+    def _backoff(self, attempt: int, deadline: Optional[float]) -> None:
+        """Sleep exp(attempt) * jitter, capped; raises ConnectionError
+        instead of sleeping past the deadline (fail before burning the
+        caller's whole budget on a nap)."""
+        delay = min(self.backoff_s * (2 ** attempt), self.backoff_max_s)
+        delay *= 0.5 + self._rng.random()  # full jitter in [0.5, 1.5)
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ConnectionError(
+                    f"deadline_s={self.deadline_s} exhausted retrying "
+                    f"{self.host}:{self.port}")
+            delay = min(delay, remaining)
+        time.sleep(delay)
+
+    def _drop_conn(self) -> None:
+        if self._rfile is not None:
+            try:
+                self._rfile.close()
+            except OSError:
+                pass
+            self._rfile = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _ensure_conn(self, deadline: Optional[float]) -> None:
+        if self._sock is not None:
+            return
+        attempt = 0
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.timeout)
+                try:
+                    self._sock.setsockopt(socket.IPPROTO_TCP,
+                                          socket.TCP_NODELAY, 1)
+                except OSError:  # pragma: no cover
+                    pass
+                self._rfile = self._sock.makefile("rb")
+                return
+            except OSError:
+                self._drop_conn()
+                if attempt >= self.retries:
+                    raise
+                self._backoff(attempt, deadline)
+                attempt += 1
 
     # ------------------------------------------------------------- io
     def score_lines(self, lines: Sequence[Line]) -> List[bytes]:
         """Pipeline a batch of request rows; returns the raw response
         line per row (no trailing newline), in request order. For very
         large batches prefer several calls — the whole request block is
-        written before responses are drained."""
-        payload = b"".join(_to_bytes(l) for l in lines)
-        self._sock.sendall(payload)
-        out = []
-        for _ in range(len(lines)):
-            resp = self._rfile.readline()
-            if not resp:
-                raise ConnectionError("server closed the connection")
-            out.append(resp.rstrip(b"\n"))
+        written before responses are drained. Reconnects and resends the
+        unanswered tail on connection failures (see module docstring)."""
+        pending = [_to_bytes(l) for l in lines]
+        out: List[bytes] = []
+        deadline = self._deadline()
+        attempt = 0
+        while pending:
+            answered = 0
+            try:
+                self._ensure_conn(deadline)
+                self._sock.sendall(b"".join(pending))
+                for _ in range(len(pending)):
+                    resp = self._rfile.readline()
+                    if not resp:
+                        raise ConnectionError(
+                            "server closed the connection")
+                    out.append(resp.rstrip(b"\n"))
+                    answered += 1
+                return out
+            except (OSError, ConnectionError):
+                # in-order responses: rows already appended to ``out``
+                # are answered for good; only the tail resends
+                pending = pending[answered:]
+                self._drop_conn()
+                if attempt >= self.retries:
+                    raise
+                self._backoff(attempt, deadline)
+                attempt += 1
         return out
 
     def predict(self, lines: Sequence[Line]) -> List[Optional[float]]:
-        """Scores per row; None where the server shed or rejected the
-        row (inspect score_lines for the reason)."""
-        out: List[Optional[float]] = []
-        for resp in self.score_lines(lines):
-            out.append(None if resp.startswith((b"!shed", b"!err"))
-                       else float(resp))
+        """Scores per row; None where the server rejected the row
+        (``!err`` — not retryable) or kept shedding it past the retry
+        budget (``!shed`` — retried with backoff when ``retries`` > 0;
+        inspect score_lines for raw reasons)."""
+        out: List[Optional[float]] = [None] * len(lines)
+        todo = list(range(len(lines)))
+        deadline = self._deadline()
+        attempt = 0
+        while todo:
+            resp = self.score_lines([lines[i] for i in todo])
+            shed = []
+            for i, r in zip(todo, resp):
+                if r.startswith(b"!shed"):
+                    shed.append(i)
+                elif not r.startswith(b"!err"):
+                    out[i] = float(r)
+            if not shed or attempt >= self.retries:
+                break
+            try:
+                self._backoff(attempt, deadline)
+            except ConnectionError:
+                break   # deadline spent: exhausted sheds surface as None
+            attempt += 1
+            todo = shed
         return out
 
     def stats(self) -> dict:
         """The server's live serving + executor counters (#stats)."""
         return json.loads(self.score_lines([b"#stats"])[0])
 
+    def health(self) -> dict:
+        """Readiness + queue depth (#health) — what a load balancer
+        polls to rotate a draining replica out before it exits."""
+        return json.loads(self.score_lines([b"#health"])[0])
+
+    def reload(self, path: Optional[str] = None) -> dict:
+        """Trigger a synchronous model hot-reload (#reload [path]);
+        returns the server's {'ok', 'model_generation'|'error'} verdict."""
+        line = b"#reload" if path is None else b"#reload " + path.encode()
+        return json.loads(self.score_lines([line])[0])
+
     def close(self) -> None:
-        try:
-            self._rfile.close()
-        finally:
-            self._sock.close()
+        self._drop_conn()
 
     def __enter__(self) -> "ServeClient":
         return self

@@ -72,18 +72,47 @@ class PredictExecutor:
         self._mu = threading.Lock()
         self._buckets: dict = {}   # statics key -> dispatch count
         self._dispatches = 0
+        # hot-reload bookkeeping (serve/reload.py swaps stores in)
+        self.generation = 1
 
     # ------------------------------------------------------------ stats
     def stats(self) -> dict:
-        """{'buckets_compiled', 'bucket_hits', 'dispatches'}: compiled
-        grows only at a bucket's first occurrence; a steady-state window
-        adds hits only (zero recompiles)."""
+        """{'buckets_compiled', 'bucket_hits', 'dispatches',
+        'model_generation'}: compiled grows only at a bucket's first
+        occurrence; a steady-state window adds hits only (zero
+        recompiles); model_generation advances once per hot reload."""
         with self._mu:
             return {
                 "buckets_compiled": len(self._buckets),
                 "bucket_hits": self._dispatches - len(self._buckets),
                 "dispatches": self._dispatches,
+                "model_generation": self.generation,
             }
+
+    # ------------------------------------------------------------- swap
+    def swap_store(self, store: SlotStore) -> int:
+        """Atomically swap a freshly-loaded store under the executor (the
+        serve hot-reload commit point). The jitted programs were built
+        from make_fns(param) — pure functions of the updater params — so
+        the replacement must match the geometry they were compiled
+        against; a mismatched reload is rejected here and the old model
+        keeps serving. The swap itself is one attribute assignment:
+        ``predict`` snapshots ``self.store`` once per call, so in-flight
+        batches finish on the model they started with."""
+        old = self.store
+        if (store.param.V_dim != old.param.V_dim
+                or store.param.hash_capacity != old.param.hash_capacity):
+            raise ValueError(
+                f"hot-reload geometry mismatch: serving "
+                f"(V_dim={old.param.V_dim}, "
+                f"hash_capacity={old.param.hash_capacity}) vs new model "
+                f"(V_dim={store.param.V_dim}, "
+                f"hash_capacity={store.param.hash_capacity}); restart the "
+                "server to change model geometry")
+        with self._mu:
+            self.store = store
+            self.generation += 1
+            return self.generation
 
     # ---------------------------------------------------------- predict
     def predict(self, blk: RowBlock) -> Tuple[np.ndarray, jnp.ndarray,
@@ -94,13 +123,17 @@ class PredictExecutor:
         if blk.size == 0:
             z = jnp.float32(0.0)
             return np.zeros(0, dtype=np.float32), z, z
+        # ONE store snapshot per batch: a concurrent hot-reload swap
+        # (swap_store) must never split a batch across two models —
+        # in-flight batches finish on the store they started with
+        store = self.store
         cblk, uniq, _ = compact(blk)
         # read-only mapping: never insert (unknown ids -> TRASH row 0,
         # whose weights are zero); sort + dedup the slot set because the
         # device kernels declare sorted unique indices, and rewrite the
         # localized columns through the permutation (the host-dedup
         # contract, store.map_keys_dedup)
-        slots = self.store.map_keys(uniq, insert=False)
+        slots = store.map_keys(uniq, insert=False)
         uniq_slots, remap = np.unique(slots, return_inverse=True)
         cblk = RowBlock(offset=cblk.offset, label=cblk.label,
                         index=remap[cblk.index].astype(np.uint32),
@@ -110,14 +143,14 @@ class PredictExecutor:
         nnz_cap = self._shapes.cap("serve.nnz", blk.nnz)
         u_cap = self._shapes.cap("serve.u", n_uniq)
         padded = pad_slots_oob(uniq_slots.astype(np.int32), u_cap,
-                               self.store.state.capacity)
+                               store.state.capacity)
         i32, f32, binary = pack_batch(cblk, n_uniq, padded, b_cap, nnz_cap,
                                       u_cap)
         key = (b_cap, nnz_cap, u_cap, binary)
         with self._mu:
             self._buckets[key] = self._buckets.get(key, 0) + 1
             self._dispatches += 1
-        pred, objv, auc = self._packed(self.store.state, jnp.asarray(i32),
+        pred, objv, auc = self._packed(store.state, jnp.asarray(i32),
                                        jnp.asarray(f32), b_cap, nnz_cap,
                                        u_cap, binary)
         return np.asarray(pred)[:blk.size], objv, auc

@@ -64,7 +64,8 @@ def model_meta(uri: str) -> dict:
         }
 
 
-def open_serving_store(model_in: str, kwargs: KWArgs = ()
+def open_serving_store(model_in: str, kwargs: KWArgs = (),
+                       fallback: bool = True
                        ) -> Tuple["SlotStore", dict, KWArgs]:
     """Read-only SlotStore loaded weights-only from ``model_in``.
 
@@ -73,14 +74,50 @@ def open_serving_store(model_in: str, kwargs: KWArgs = ()
     gets the right table without repeating training knobs. Remaining
     updater keys (V_dtype, l1_shrk, ...) are still consumed from
     ``kwargs`` so the gather-side semantics can be overridden when
-    needed. Returns (store, meta, leftover kwargs)."""
+    needed. Returns (store, meta, leftover kwargs).
+
+    Every candidate is manifest-verified before loading
+    (utils/manifest.py). When the resolved file is corrupt/torn and
+    ``fallback`` is on (serve startup), the loader walks the checkpoint
+    family back to the newest generation that verifies — a torn final
+    save must not take a replica down when a good interval checkpoint
+    sits next to it. ``fallback=False`` (hot reload) raises instead: a
+    failed reload keeps the CURRENT in-memory model, never silently
+    regresses to an older file."""
+    from ..utils import manifest as mft
+    from ..utils.manifest import CheckpointCorrupt
+
+    path = resolve_model_path(model_in)
+    candidates = [path]
+    if fallback:
+        candidates += [p for p in mft.generation_paths(path) if p != path]
+    last_err: Optional[CheckpointCorrupt] = None
+    for cand in candidates:
+        try:
+            mft.verify(cand)
+        except FileNotFoundError:
+            continue
+        except CheckpointCorrupt as e:
+            log.warning("serving model candidate failed verification: %s", e)
+            last_err = e
+            continue
+        if cand != path:
+            log.warning("model %s is corrupt; serving previous verified "
+                        "generation %s instead", path, cand)
+        return _open_verified(cand, kwargs)
+    assert last_err is not None
+    raise last_err
+
+
+def _open_verified(path: str, kwargs: KWArgs
+                   ) -> Tuple["SlotStore", dict, KWArgs]:
     from ..store.local import SlotStore
     from ..updaters.sgd_updater import SGDUpdaterParam
 
-    meta = model_meta(model_in)
+    meta = model_meta(path)
     if meta["learner"] not in (None, "sgd"):
         raise ValueError(
-            f"model {model_in!r} was produced by "
+            f"model {path!r} was produced by "
             f"learner={meta['learner']!r}; the serving executor loads sgd "
             "SlotStore checkpoints only — re-train with learner=sgd to "
             "serve this data")
@@ -88,7 +125,8 @@ def open_serving_store(model_in: str, kwargs: KWArgs = ()
     uparam = dataclasses.replace(uparam, V_dim=meta["V_dim"],
                                  hash_capacity=meta["hash_capacity"])
     store = SlotStore(uparam, read_only=True)
-    n = store.load(meta["path"])
+    # verify=False: the caller just manifest-verified this exact file
+    n = store.load(meta["path"], verify=False)
     log.info("serving store: %s (%s, V_dim=%d, %d non-empty entries, "
              "weights-only)", meta["path"],
              "hashed" if meta["hashed"] else "dictionary", meta["V_dim"], n)

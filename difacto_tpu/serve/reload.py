@@ -1,0 +1,135 @@
+"""Serve hot-reload: pick up a newly-trained model without a restart.
+
+The trainer's side of the lifecycle ends at a checkpoint on disk; before
+this module the server's side began with a process restart — a cold
+executor, recompiled buckets, and a dropped listening socket. The
+``ModelReloader`` closes that gap:
+
+- a **watcher** thread polls the model path (manifest generation first,
+  mtime/size as the legacy fallback) every ``poll_s`` seconds and
+  triggers a reload when the fingerprint moves, so a `model_out` that the
+  trainer re-saves is picked up automatically;
+- the ``#reload [path]`` control line triggers the same reload on demand
+  (handled on the requesting connection's reader thread — scoring never
+  stalls behind a load);
+- the reload itself loads the new model **weights-only in the
+  background** through ``open_serving_store(fallback=False)`` — full
+  manifest verification, no silent walk-back — and only then swaps it
+  into the executor atomically (``PredictExecutor.swap_store``:
+  in-flight batches finish on the old model; the compiled predict
+  programs survive because the geometry is checked);
+- a failed or corrupt load **keeps the old model serving** and records
+  ``reload_failures``; ``#stats`` carries ``model_generation`` /
+  ``reloads`` / ``reload_failures`` so a fleet can alert on a replica
+  that's stuck behind the model it should be serving.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional, Tuple
+
+from ..utils import stream
+
+log = logging.getLogger("difacto_tpu")
+
+
+class ModelReloader:
+    def __init__(self, executor, model_uri: str, poll_s: float = 0.0,
+                 kwargs=()):
+        self.executor = executor
+        self.model_uri = model_uri
+        self.poll_s = poll_s
+        self._kwargs = list(kwargs)
+        self.reloads = 0
+        self.reload_failures = 0
+        self._reload_mu = threading.Lock()   # serialize concurrent reloads
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._cur = self._fingerprint()
+
+    # ------------------------------------------------------------ watch
+    def _fingerprint(self) -> Optional[Tuple]:
+        """(path, manifest generation, mtime, size) of the current model
+        file; None while unresolvable. Generation is the real signal —
+        mtime/size only cover legacy manifest-less files."""
+        from ..utils import manifest as mft
+        from .model import resolve_model_path
+        try:
+            path = resolve_model_path(self.model_uri)
+            man = mft.read(path)
+            gen = man.get("generation") if man else None
+            return (path, gen, stream.getmtime(path), stream.getsize(path))
+        except (FileNotFoundError, OSError, mft.CheckpointCorrupt):
+            return None
+
+    def start(self) -> "ModelReloader":
+        if self.poll_s > 0 and self._thread is None:
+            self._thread = threading.Thread(target=self._watch,
+                                            name="serve-reload-watch",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def _changed(self, fp: Optional[Tuple]) -> bool:
+        """When both fingerprints carry a manifest generation, only a
+        generation move counts — the npz lands before its manifest, so a
+        new mtime under the old generation is a save in progress, not a
+        model to load (reloading mid-write would burn a failure)."""
+        if fp is None or fp == self._cur:
+            return False
+        if self._cur is None:
+            return True
+        if fp[1] is not None and self._cur[1] is not None:
+            return fp[0] != self._cur[0] or fp[1] != self._cur[1]
+        return True
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            fp = self._fingerprint()
+            if self._changed(fp):
+                log.info("model watcher: %s changed (generation %s); "
+                         "reloading", fp[0], fp[1])
+                self.reload()
+
+    # ----------------------------------------------------------- reload
+    def reload(self, path: Optional[str] = None) -> dict:
+        """Load + verify + swap, synchronously on the calling thread.
+        Returns {'ok', 'model_generation'} or {'ok': False, 'error'} —
+        the old model keeps serving on any failure."""
+        from .model import open_serving_store
+        target = path or self.model_uri
+        with self._reload_mu:
+            fp = self._fingerprint() if path is None else None
+            try:
+                # fallback=False: reloading must never silently regress
+                # to an older generation — the current in-memory model IS
+                # the fallback
+                store, meta, _ = open_serving_store(target, self._kwargs,
+                                                    fallback=False)
+                gen = self.executor.swap_store(store)
+            except Exception as e:
+                self.reload_failures += 1
+                log.warning("model reload from %s failed; keeping the "
+                            "current model: %s", target, e)
+                return {"ok": False, "error": str(e)}
+            self.reloads += 1
+            if fp is not None:
+                self._cur = fp
+            log.info("model reloaded from %s: generation %d",
+                     meta["path"], gen)
+            return {"ok": True, "model_generation": gen,
+                    "path": meta["path"]}
+
+    # ------------------------------------------------------------ stats
+    def stats(self) -> dict:
+        return {"reloads": self.reloads,
+                "reload_failures": self.reload_failures}

@@ -31,6 +31,7 @@ import time
 from typing import Optional
 
 from ..data.parsers import get_parser
+from ..utils import faultinject
 from ..utils.reporter import Reporter
 from .batcher import MicroBatcher, ServeStats
 from .executor import PredictExecutor, sigmoid
@@ -44,7 +45,8 @@ class ServeServer:
                  max_delay_ms: float = 2.0, queue_cap: int = 1024,
                  pred_prob: bool = True, data_format: str = "libsvm",
                  max_row_nnz: int = 4096, report_every_s: float = 30.0,
-                 reporter: Optional[Reporter] = None):
+                 reporter: Optional[Reporter] = None,
+                 drain_timeout_s: float = 10.0):
         self.executor = PredictExecutor(store, loss=loss)
         if reporter is None:
             reporter = Reporter(every=1)
@@ -57,11 +59,17 @@ class ServeServer:
                                     queue_cap=queue_cap, stats=self.stats)
         self.pred_prob = pred_prob
         self.max_row_nnz = max_row_nnz
+        self.drain_timeout_s = drain_timeout_s
+        # attached by run_serve / bench: a reload.ModelReloader serving
+        # the #reload control line and the background model watcher
+        self.reloader = None
+        self.draining = False
         self._parser = get_parser(data_format)
         self._sock = socket.create_server((host, port))
         self._sock.settimeout(0.25)
         self.host, self.port = self._sock.getsockname()[:2]
         self._alive = False
+        self._closed = False
         self._done = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: set = set()
@@ -86,10 +94,13 @@ class ServeServer:
         self._done.wait(timeout)
 
     def close(self) -> None:
-        """Stop accepting, drain connections, join every thread, unlink
-        the socket — idempotent."""
-        if not self._alive and self._accept_thread is None:
-            return
+        """Stop accepting, drop connections, join every thread, unlink
+        the socket — idempotent and safe to race from a signal handler
+        against the normal shutdown path."""
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
         self._alive = False
         self._done.set()
         try:
@@ -111,9 +122,54 @@ class ServeServer:
         self._conn_threads.clear()
         self.batcher.close()
 
+    def drain(self, timeout_s: Optional[float] = None) -> float:
+        """Graceful shutdown: stop accepting NEW connections, answer new
+        rows with ``!shed draining`` (retry-elsewhere backpressure), wait
+        for every admitted row — queued and mid-batch — to resolve, then
+        close. Bounded by ``drain_timeout_s``: a wedged batch can delay
+        exit by at most that much, never hang it. Returns the seconds the
+        drain took; idempotent with close(). This is what the SIGTERM/
+        SIGINT handlers (run_serve) call so a load balancer rotating a
+        replica out never sees admitted work dropped."""
+        timeout = self.drain_timeout_s if timeout_s is None else timeout_s
+        t0 = time.monotonic()
+        self.draining = True
+        self._alive = False   # accept loop exits; close() joins it
+        try:
+            self._sock.close()
+        except OSError:  # pragma: no cover
+            pass
+        deadline = t0 + timeout
+        while time.monotonic() < deadline:
+            if self.batcher.idle:
+                break
+            time.sleep(0.02)
+        else:
+            log.warning("drain timed out after %.1fs with %d rows queued",
+                        timeout, self.batcher.rows_queued)
+        # one beat for connection writer threads to flush resolved
+        # futures before connections are shut down
+        time.sleep(0.05)
+        self.close()
+        return time.monotonic() - t0
+
     def stats_snapshot(self) -> dict:
-        """Serving counters + executor bucket stats, one flat dict."""
-        return dict(self.stats.snapshot(), **self.executor.stats())
+        """Serving counters + executor bucket stats (incl.
+        model_generation) + reload counters, one flat dict."""
+        out = dict(self.stats.snapshot(), **self.executor.stats())
+        if self.reloader is not None:
+            out.update(self.reloader.stats())
+        return out
+
+    def health_snapshot(self) -> dict:
+        """The ``#health`` payload: readiness for load-balancer rotation
+        plus the queue depth that predicts admission latency."""
+        return {
+            "status": "draining" if self.draining else "ready",
+            "queue_depth": self.batcher.rows_queued,
+            "queue_cap": self.batcher.queue_cap,
+            "model_generation": self.executor.generation,
+        }
 
     # ------------------------------------------------------- connection
     def _accept_loop(self) -> None:
@@ -155,6 +211,14 @@ class ServeServer:
         try:
             rfile = conn.makefile("rb")
             for line in rfile:
+                # chaos harness: an injected ``close`` here models the
+                # peer/kernel tearing the connection down mid-request
+                if faultinject.fire("serve.sock.read") == "close":
+                    try:
+                        conn.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    break
                 line = line.strip()
                 if not line:
                     continue
@@ -162,6 +226,12 @@ class ServeServer:
                     replies.put(("raw", self._control(line), 0.0))
                     continue
                 t0 = time.monotonic()
+                if self.draining:
+                    # starts with !shed so every client treats it as the
+                    # retry-elsewhere backpressure signal it is
+                    self.stats.record_shed()
+                    replies.put(("raw", b"!shed draining\n", 0.0))
+                    continue
                 try:
                     blk = self._parser(line)
                 except Exception:
@@ -177,7 +247,12 @@ class ServeServer:
                         b"!err row exceeds serve_max_row_nnz=%d\n"
                         % self.max_row_nnz, 0.0))
                     continue
-                fut = self.batcher.submit(blk)
+                try:
+                    fut = self.batcher.submit(blk)
+                except faultinject.FaultInjected as e:
+                    self.stats.record_error()
+                    replies.put(("raw", b"!err %s\n" % str(e).encode(), 0.0))
+                    continue
                 if fut is None:
                     replies.put(("raw", b"!shed\n", 0.0))
                 else:
@@ -197,6 +272,16 @@ class ServeServer:
     def _control(self, line: bytes) -> bytes:
         if line == b"#stats":
             return (json.dumps(self.stats_snapshot()) + "\n").encode()
+        if line == b"#health":
+            return (json.dumps(self.health_snapshot()) + "\n").encode()
+        if line == b"#reload" or line.startswith(b"#reload "):
+            # synchronous on THIS connection's reader thread: scoring
+            # traffic on other connections keeps flowing through the
+            # batcher while the new model loads; the swap is atomic
+            if self.reloader is None:
+                return b"!err no reloader configured (set model_in)\n"
+            path = line[len(b"#reload"):].strip().decode() or None
+            return (json.dumps(self.reloader.reload(path)) + "\n").encode()
         return b"!err unknown control %s\n" % line[:32]
 
     def _writer(self, conn: socket.socket, replies: "queue.Queue") -> None:
@@ -204,6 +289,15 @@ class ServeServer:
             while True:
                 item = replies.get()
                 if item is None:
+                    return
+                # chaos harness: an injected ``close`` drops the
+                # connection mid-response-stream — the exact failure the
+                # retrying client must survive
+                if faultinject.fire("serve.sock.write") == "close":
+                    try:
+                        conn.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
                     return
                 kind, payload, t0 = item
                 if kind == "raw":

@@ -28,6 +28,8 @@ import numpy as np
 
 from ..base import FEAID_DTYPE, reverse_bytes
 from ..utils import stream
+from ..utils import manifest as mft
+from ..utils.manifest import CheckpointCorrupt  # noqa: F401 (re-export)
 from ..updaters.sgd_updater import (SGDState, SGDUpdaterParam, TRASH_SLOT,
                                     grow_state, init_state, make_fns)
 
@@ -380,9 +382,18 @@ class SlotStore:
                         cnt=empty + 0, VVg=T,
                         v_live=jnp.zeros(0, dtype=bool))
 
-    def save(self, path: str, save_aux: bool = False) -> int:
+    def save(self, path: str, save_aux: bool = False,
+             epoch: Optional[int] = None, keep: int = 0) -> int:
         """Checkpoint non-empty entries, sorted by key. Hashed mode has no
-        id dictionary — the full dense table is saved instead."""
+        id dictionary — the full dense table is saved instead.
+
+        Every save leaves a ``<path>.manifest.json`` sidecar (per-array
+        sha256, row count, learner, epoch, monotonically increasing
+        generation; utils/manifest.py) written AFTER the npz finalizes —
+        the commit marker a torn write can't fake. ``keep > 0`` retires
+        interval (``_iter-k``) checkpoints of this family older than the
+        newest ``keep`` epochs; the final undecorated model is never
+        pruned."""
         saved = ("w", "cnt", "v_live", "V") + (
             ("z", "sqrt_g", "Vg") if save_aux else ())
         if self.hashed:
@@ -392,41 +403,63 @@ class SlotStore:
                           save_aux=np.array(save_aux),
                           learner=np.array("sgd"),
                           **{k: st[k] for k in saved})
-            # uncompressed: a trained 4.2M-row V16 state is ~300 MB and
-            # np.savez_compressed writes it at ~6 MB/s — ~50 s added to
-            # every epoch checkpoint (the rec data cache dropped zlib
-            # for the same reason, docs/perf_notes.md streamed regime)
-            stream.save_npz(path, compress=False, **arrays)
-            return int((st["w"] != 0).sum())
-        keys, slots = self._sorted_items()
-        st = self._state_np(self.state, keys=saved)
-        keep = (st["w"][slots] != 0) | (st["cnt"][slots] != 0)
-        if self.param.V_dim > 0:
-            keep |= st["v_live"][slots]
-        keys, slots = keys[keep], slots[keep]
-        arrays = dict(
-            keys=keys,
-            w=st["w"][slots],
-            cnt=st["cnt"][slots],
-            v_live=st["v_live"][slots],
-            V=st["V"][slots],
-            save_aux=np.array(save_aux),
-            V_dim=np.array(self.param.V_dim),
-            learner=np.array("sgd"),
-        )
-        if save_aux:
-            arrays.update(z=st["z"][slots], sqrt_g=st["sqrt_g"][slots],
-                          Vg=st["Vg"][slots])
-        stream.save_npz(path, compress=False, **arrays)
-        return len(keys)
+            n = int((st["w"] != 0).sum())
+        else:
+            keys, slots = self._sorted_items()
+            st = self._state_np(self.state, keys=saved)
+            live = (st["w"][slots] != 0) | (st["cnt"][slots] != 0)
+            if self.param.V_dim > 0:
+                live |= st["v_live"][slots]
+            keys, slots = keys[live], slots[live]
+            arrays = dict(
+                keys=keys,
+                w=st["w"][slots],
+                cnt=st["cnt"][slots],
+                v_live=st["v_live"][slots],
+                V=st["V"][slots],
+                save_aux=np.array(save_aux),
+                V_dim=np.array(self.param.V_dim),
+                learner=np.array("sgd"),
+            )
+            if save_aux:
+                arrays.update(z=st["z"][slots], sqrt_g=st["sqrt_g"][slots],
+                              Vg=st["Vg"][slots])
+            n = len(keys)
+        man = {"learner": "sgd", "rows": n, "save_aux": bool(save_aux),
+               "generation": mft.next_generation(path)}
+        if epoch is not None:
+            man["epoch"] = int(epoch)
+        # uncompressed: a trained 4.2M-row V16 state is ~300 MB and
+        # np.savez_compressed writes it at ~6 MB/s — ~50 s added to
+        # every epoch checkpoint (the rec data cache dropped zlib
+        # for the same reason, docs/perf_notes.md streamed regime)
+        stream.save_npz(path, compress=False, manifest=man,
+                        fault_point="ckpt.write", **arrays)
+        if keep > 0:
+            import re
+            m = re.search(r"_part-(\d+)", path)
+            mft.prune_checkpoints(path, keep,
+                                  rank=int(m.group(1)) if m else None)
+        return n
 
-    def load(self, path: str, weights_only: Optional[bool] = None) -> int:
+    def load(self, path: str, weights_only: Optional[bool] = None,
+             verify: bool = True, require_manifest: bool = False) -> int:
         """Restore a checkpoint. ``weights_only`` (default: the store's
         read_only flag) loads just what inference reads — w / cnt /
         v_live / V — and never materializes optimizer state (z, sqrt_g,
         Vg) on the host even when the checkpoint carries it: aux columns
         are stride-0 zero views, so a serving process pays no host RAM
-        for state it will never update."""
+        for state it will never update.
+
+        ``verify`` (default on) checks the manifest sidecar first and
+        raises a typed :class:`CheckpointCorrupt` on truncation / digest
+        mismatch instead of crashing in numpy; callers that already
+        verified (serve walk-back) pass verify=False to skip the second
+        read. ``require_manifest`` additionally treats a missing sidecar
+        as corruption — the contract for files this codebase wrote
+        (auto_resume candidates always have one)."""
+        if verify:
+            mft.verify(path, require_manifest=require_manifest)
         if weights_only is None:
             weights_only = self.read_only
         loaded = (("w", "cnt", "v_live", "V") if weights_only
@@ -436,7 +469,7 @@ class SlotStore:
             # stride-0 zeros: a weights-only load allocates no aux memory
             return np.broadcast_to(np.float32(0.0), shape)
 
-        with stream.load_npz(path) as z:
+        with stream.load_npz(path, fault_point="ckpt.read") as z:
             if self.hashed != ("hash_capacity" in z.files):
                 raise ValueError(
                     "checkpoint store mode mismatch: "

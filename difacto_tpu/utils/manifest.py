@@ -1,0 +1,238 @@
+"""Checkpoint manifests: sidecar verification + generation bookkeeping.
+
+The whole recovery story (launch.py eviction-restart, SGD auto_resume,
+serve model loading) pivots on one artifact — the saved ``.npz`` — and
+before this module nothing checked that the artifact was intact: a
+truncated upload or a bit-flipped array crashed ``auto_resume`` deep in
+numpy with no fallback. Every checkpoint writer now leaves a sidecar
+
+    <path>.manifest.json
+      {"format": 1, "generation": 7, "rows": 12345, "learner": "sgd",
+       "epoch": 3, "arrays": {"w": {"sha256": ..., "dtype": "<f4",
+                                    "shape": [12345]}, ...}}
+
+written strictly AFTER the npz finalizes, so the manifest doubles as the
+commit marker: a torn write (crash/SIGKILL mid-upload) leaves either no
+manifest or digests that don't match, and both read as "this generation
+is incomplete" instead of a crash. ``generation`` increases monotonically
+across every save of the same checkpoint *family* (the prefix with
+``_iter-k`` / ``_part-r`` / ``.npz`` suffixes stripped), which is what
+lets loaders walk back to the newest generation that verifies and lets
+``prune_checkpoints`` retire the oldest interval checkpoints.
+
+``verify`` is the single gate: SGD ``auto_resume`` requires a manifest
+(this codebase always writes one, so a missing sidecar there means a torn
+save); ``task=pred``/``task=serve`` accept legacy manifest-less files but
+still fail typed — :class:`CheckpointCorrupt` names the bad file and the
+reason — instead of surfacing ``zipfile.BadZipFile`` from numpy's guts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+import zipfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import stream
+
+MANIFEST_SUFFIX = ".manifest.json"
+FORMAT = 1
+
+# the per-rank / per-epoch decorations learners append to a model prefix
+# (learners/sgd.py _model_name, lbfgs/bcd _ckpt_path)
+_DECOR_RE = re.compile(r"(?:_iter-\d+)?(?:_part-\d+)?(?:\.npz)?$")
+_ITER_RE = re.compile(r"_iter-(\d+)")
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed verification: truncated/torn npz, digest
+    mismatch (bit flip), or a missing/incomplete manifest where one is
+    required. Carries the path and reason so the error is actionable."""
+
+    def __init__(self, path: str, reason: str):
+        self.path = path
+        self.reason = reason
+        super().__init__(
+            f"corrupt checkpoint {path!r}: {reason}. Delete or replace "
+            "the file, or point at an older generation (auto_resume and "
+            "task=serve fall back to the newest verified one "
+            "automatically).")
+
+
+def manifest_path(uri: str) -> str:
+    return uri + MANIFEST_SUFFIX
+
+
+def family_prefix(uri: str) -> str:
+    """The checkpoint family a file belongs to: its path with the
+    ``_iter-k`` / ``_part-r`` / ``.npz`` decorations stripped. One family
+    = one trained model's saves, across epochs and ranks."""
+    return _DECOR_RE.sub("", uri)
+
+
+def _digest(arr: np.ndarray) -> str:
+    a = np.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(str(a.dtype.str).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def build(arrays: Dict[str, np.ndarray], **extra) -> dict:
+    """Manifest dict for a set of named arrays. ``extra`` carries the
+    writer's metadata (learner, epoch, rows, generation)."""
+    man = {"format": FORMAT}
+    man.update(extra)
+    man["arrays"] = {
+        name: {"sha256": _digest(np.asarray(a)),
+               "dtype": str(np.asarray(a).dtype.str),
+               "shape": list(np.asarray(a).shape)}
+        for name, a in arrays.items()}
+    return man
+
+
+def write(uri: str, man: dict) -> None:
+    with stream.open_stream(manifest_path(uri), "w") as f:
+        f.write(json.dumps(man, sort_keys=True))
+
+
+def read(uri: str) -> Optional[dict]:
+    """The manifest for ``uri``, or None when the sidecar is absent.
+    An unreadable/garbled sidecar counts as corrupt, not absent — it
+    means the save tore mid-manifest."""
+    mp = manifest_path(uri)
+    if not stream.exists(mp):
+        return None
+    try:
+        with stream.open_stream(mp, "r") as f:
+            man = json.loads(f.read())
+        if not isinstance(man, dict) or "arrays" not in man:
+            raise ValueError("manifest missing 'arrays'")
+        return man
+    except (ValueError, OSError) as e:
+        raise CheckpointCorrupt(uri, f"unreadable manifest: {e}") from e
+
+
+def verify(uri: str, require_manifest: bool = False) -> Optional[dict]:
+    """Verify checkpoint ``uri`` against its manifest.
+
+    Returns the manifest dict (None for an accepted legacy manifest-less
+    file). Raises FileNotFoundError when the npz itself is missing (so
+    existence probes keep their semantics) and CheckpointCorrupt on any
+    verification failure. With ``require_manifest`` a missing sidecar is
+    itself corruption — the right contract for files this codebase wrote
+    (save always leaves a manifest, so its absence means a torn save).
+    """
+    if not stream.isfile(uri):
+        raise FileNotFoundError(uri)
+    man = read(uri)
+    if man is None:
+        if require_manifest:
+            raise CheckpointCorrupt(
+                uri, "manifest missing — incomplete (torn) checkpoint, "
+                     "or a file not written by a difacto save")
+        # legacy file: no digests to check, but at least require a
+        # readable zip so numpy's BadZipFile never escapes untyped
+        try:
+            with stream.load_npz(uri) as z:
+                z.files
+        except FileNotFoundError:
+            raise
+        except Exception as e:
+            raise CheckpointCorrupt(uri, f"unreadable npz: {e}") from e
+        return None
+    try:
+        with stream.load_npz(uri) as z:
+            names = set(z.files)
+            for name, info in man["arrays"].items():
+                if name not in names:
+                    raise CheckpointCorrupt(
+                        uri, f"array {name!r} listed in manifest but "
+                             "missing from npz (truncated write)")
+                a = z[name]
+                if _digest(a) != info["sha256"]:
+                    raise CheckpointCorrupt(
+                        uri, f"array {name!r} sha256 mismatch (bit flip "
+                             "or partial write)")
+    except CheckpointCorrupt:
+        raise
+    except FileNotFoundError:
+        raise
+    except (zipfile.BadZipFile, ValueError, KeyError, OSError,
+            EOFError) as e:
+        raise CheckpointCorrupt(uri, f"unreadable npz: {e}") from e
+    return man
+
+
+# ------------------------------------------------------- generations
+
+def _family_manifests(uri: str) -> List[Tuple[int, str]]:
+    """[(generation, npz_path)] for every manifest in ``uri``'s family,
+    newest generation first. Unreadable sidecars are skipped (they will
+    fail verify later anyway)."""
+    fam = family_prefix(uri)
+    out = []
+    for mp in stream.glob(fam + "*" + MANIFEST_SUFFIX):
+        base = mp[:-len(MANIFEST_SUFFIX)]
+        if family_prefix(base) != fam:
+            continue  # a longer sibling prefix globbed in
+        try:
+            with stream.open_stream(mp, "r") as f:
+                man = json.loads(f.read())
+            gen = int(man.get("generation", 0))
+        except (ValueError, OSError, KeyError):
+            continue
+        out.append((gen, base))
+    out.sort(key=lambda t: (-t[0], t[1]))
+    return out
+
+
+def next_generation(uri: str) -> int:
+    """The monotonically-increasing generation number the next save of
+    this family should stamp (max existing + 1; first save = 1)."""
+    gens = _family_manifests(uri)
+    return (gens[0][0] + 1) if gens else 1
+
+
+def generation_paths(uri: str) -> List[str]:
+    """Checkpoint paths of ``uri``'s family, newest generation first —
+    the walk-back order for loaders recovering from a corrupt file."""
+    return [p for _, p in _family_manifests(uri)]
+
+
+def prune_checkpoints(model_prefix: str, keep: int,
+                      rank: Optional[int] = None) -> List[str]:
+    """Retire interval checkpoints older than the newest ``keep`` epochs
+    of ``model_prefix``'s family. Only ``_iter-k`` files are candidates —
+    the final (undecorated) model is never pruned. With ``rank`` set only
+    that rank's ``_part-<rank>`` files are removed (each host prunes what
+    it wrote; no cross-host delete races). Returns the removed paths."""
+    if keep <= 0:
+        return []
+    fam = family_prefix(model_prefix)
+    by_epoch: Dict[int, List[str]] = {}
+    for path in stream.glob(fam + "_iter-*"):
+        if path.endswith(MANIFEST_SUFFIX):
+            continue
+        m = _ITER_RE.search(path)
+        if m is None:
+            continue
+        if rank is not None and f"_part-{rank}" not in path[m.end():]:
+            continue
+        by_epoch.setdefault(int(m.group(1)), []).append(path)
+    removed = []
+    for epoch in sorted(by_epoch)[:-keep]:
+        for path in by_epoch[epoch]:
+            for p in (path, manifest_path(path)):
+                try:
+                    stream.remove(p)
+                    if p == path:
+                        removed.append(p)
+                except (FileNotFoundError, OSError):
+                    pass
+    return removed

@@ -16,7 +16,7 @@ from __future__ import annotations
 import glob as _glob
 import io
 import os
-from typing import IO, List
+from typing import IO, List, Optional
 
 import numpy as np
 
@@ -142,33 +142,112 @@ def makedirs(uri: str) -> None:
     os.makedirs(_strip_file_scheme(uri), exist_ok=True)
 
 
+def remove(uri: str) -> None:
+    """Delete a file (checkpoint pruning, tmp-key cleanup)."""
+    if is_remote(uri):
+        fs, path = _fs(uri)
+        fs.rm(path)
+        return
+    os.remove(_strip_file_scheme(uri))
+
+
+def getmtime(uri: str) -> float:
+    """Last-modified time (seconds); 0.0 when the backend can't say —
+    the serve hot-reload watcher treats mtime as a hint and falls back
+    to manifest generations."""
+    if is_remote(uri):
+        fs, path = _fs(uri)
+        try:
+            return fs.modified(path).timestamp()
+        except (NotImplementedError, AttributeError, OSError):
+            return 0.0
+    return os.path.getmtime(_strip_file_scheme(uri))
+
+
 def join(uri: str, *parts: str) -> str:
     if is_remote(uri):
         return "/".join([uri.rstrip("/"), *parts])
     return os.path.join(_strip_file_scheme(uri), *parts)
 
 
-def save_npz(uri: str, compress: bool = True, **arrays) -> None:
-    """Atomic-as-possible npz write: local goes through tmp+rename, remote
-    uploads a serialized buffer in one put."""
+def save_npz(uri: str, compress: bool = True, manifest: Optional[dict] = None,
+             fault_point: str = "", **arrays) -> None:
+    """Atomic npz write: local goes through tmp+rename; remote uploads to
+    a ``<path>.tmp`` key then finalizes with a server-side move, so a
+    reader can never observe a half-uploaded object under the real key
+    (the old single-put left exactly that window).
+
+    ``manifest`` (extra metadata: learner/epoch/rows/generation) turns on
+    the checkpoint-verification sidecar: ``<path>.manifest.json`` with
+    per-array sha256 digests is written strictly AFTER the npz finalizes,
+    so it doubles as the commit marker — a crash between the two leaves a
+    checkpoint that loaders treat as incomplete (utils/manifest.py).
+
+    ``fault_point`` names the chaos-harness injection point to traverse
+    (utils/faultinject.py): ``truncate`` tears the artifact (half-length
+    final bytes, no manifest — the shape a crash mid-upload produces) and
+    ``kill`` tears it then SIGKILLs, which is what the mid-checkpoint
+    crash test arms.
+    """
+    from . import faultinject
+    kind = faultinject.fire(fault_point) if fault_point else None
     save = np.savez_compressed if compress else np.savez
     if is_remote(uri):
         buf = io.BytesIO()
         save(buf, **arrays)
-        with open_stream(uri, "wb") as f:
-            f.write(buf.getvalue())
-        return
-    path = _strip_file_scheme(uri)
-    _ensure_parent(path)
-    tmp = path + ".tmp.npz"  # .npz suffix stops savez appending its own
-    save(tmp, **arrays)
-    os.replace(tmp, path)
+        data = buf.getvalue()
+        if kind in ("truncate", "kill"):
+            _torn_write(uri, data, kind)
+            return
+        tmp = uri + ".tmp"
+        with open_stream(tmp, "wb") as f:
+            f.write(data)
+        fs, path = _fs(uri)
+        _, tmp_path = _fs(tmp)
+        try:
+            fs.mv(tmp_path, path)
+        except (AttributeError, NotImplementedError):  # pragma: no cover
+            fs.copy(tmp_path, path)
+            fs.rm(tmp_path)
+    else:
+        path = _strip_file_scheme(uri)
+        _ensure_parent(path)
+        tmp = path + ".tmp.npz"  # .npz suffix stops savez appending its own
+        save(tmp, **arrays)
+        if kind in ("truncate", "kill"):
+            with open(tmp, "rb") as f:
+                data = f.read()
+            os.remove(tmp)
+            _torn_write(path, data, kind)
+            return
+        os.replace(tmp, path)
+    if manifest is not None:
+        from . import manifest as _mft
+        _mft.write(uri, _mft.build(
+            {k: np.asarray(v) for k, v in arrays.items()}, **manifest))
 
 
-def load_npz(uri: str):
+def _torn_write(uri: str, data: bytes, kind: str) -> None:
+    """Injected torn write: half the bytes land under the FINAL name
+    (bypassing the tmp+rename discipline — this is the failure that
+    discipline exists to prevent), no manifest follows, and ``kill``
+    then takes the process down like a real SIGKILL mid-checkpoint."""
+    with open_stream(uri, "wb") as f:
+        f.write(data[:max(len(data) // 2, 1)])
+    if kind == "kill":  # pragma: no cover - the process dies here
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def load_npz(uri: str, fault_point: str = ""):
     """np.load over a stream; caller uses it as a context manager. Remote
     files are fetched into memory first (np.load needs a seekable file and
-    npz member access does many small reads)."""
+    npz member access does many small reads). ``fault_point`` traverses a
+    chaos-harness injection point (``err`` surfaces as the same OSError a
+    failing disk/network read raises)."""
+    if fault_point:
+        from . import faultinject
+        faultinject.act_default(faultinject.fire(fault_point))
     if is_remote(uri):
         with open_stream(uri, "rb") as f:
             return np.load(io.BytesIO(f.read()))

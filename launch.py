@@ -61,10 +61,29 @@ from __future__ import annotations
 
 import argparse
 import os
+import random
 import shlex
 import subprocess
 import sys
 import time
+
+# relaunch backoff cap: repeated eviction-relaunch cycles wait at most
+# this long (plus jitter) between attempts
+RELAUNCH_BACKOFF_CAP_S = 60.0
+
+
+def _relaunch_delay(attempt: int, hb_timeout: float,
+                    rng: random.Random = random) -> float:
+    """Seconds to wait before relaunch ``attempt`` + 1: exponential in
+    the attempt number with full jitter, floored at one heartbeat
+    timeout (ssh can't kill remote stragglers — orphans of the failed
+    attempt need a full hb window to notice their dead peers and
+    self-abort before the relaunch races them) and capped so a long
+    eviction cascade doesn't stall recovery. The jitter is the point:
+    a fleet of restarting launchers must not stampede the coordinator
+    port in lockstep."""
+    base = min(hb_timeout * (2 ** attempt), RELAUNCH_BACKOFF_CAP_S)
+    return max(hb_timeout, base * (0.5 + rng.random()))
 
 
 def _read_hostfile(path: str) -> list:
@@ -513,10 +532,9 @@ def main() -> int:
             else:
                 victim = len(cur_hosts) - 1
             evicted = cur_hosts.pop(victim)
-            # ssh cannot kill remote stragglers; give orphans of the
-            # failed attempt one heartbeat timeout to notice their dead
-            # peers and self-abort before the relaunch races them
-            time.sleep(args.hb_timeout)
+            # exponential backoff + jitter between relaunches (floored
+            # at one heartbeat timeout so ssh orphans self-abort first)
+            time.sleep(_relaunch_delay(attempt, args.hb_timeout))
             print(f"[launch] attempt {attempt} failed (rc={rc}); evicting "
                   f"{evicted}, relaunching on {cur_hosts}", file=sys.stderr)
         else:

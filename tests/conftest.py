@@ -24,6 +24,18 @@ import pathlib
 import pytest
 
 
+def pytest_configure(config):
+    # registered here (no pytest.ini): `slow` gates tier-2-only tests
+    # out of the tier-1 `-m 'not slow'` run; `chaos` tags the
+    # fault-injection resilience suite (tests/test_chaos.py) — IN
+    # tier-1, selectable alone with `-m chaos`
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection resilience suite (runs in tier-1)")
+
+
 @pytest.fixture(scope="session")
 def rcv1_path() -> str:
     """First 100 rows of the public rcv1.binary dataset (libsvm format) —

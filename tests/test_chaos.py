@@ -1,0 +1,478 @@
+"""Fault-injection chaos suite (ISSUE 3): the resilience layer, proven
+under the failures it exists for.
+
+Covers the four acceptance legs end to end — SIGKILL mid-checkpoint then
+auto-resume from the previous verified generation; hot-reload under live
+loadgen traffic with zero errors; SIGTERM graceful drain under load;
+client retry through injected socket closes — plus the corrupt-
+checkpoint matrix (truncated npz, bit-flipped array, missing manifest)
+against auto_resume / task=pred / task=serve, the fault-registry
+mechanics, the atomic remote save, and relaunch backoff.
+
+Conventions: every network/subprocess-bearing test runs under an
+explicit SIGALRM deadline (the test_serve.py/test_producer_process.py
+convention) and carries the ``chaos`` marker (conftest.py) so the suite
+is selectable alone with ``-m chaos`` while staying in tier-1.
+"""
+
+import contextlib
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from difacto_tpu.__main__ import main
+from difacto_tpu.utils import faultinject
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.chaos
+
+
+@contextlib.contextmanager
+def deadline(seconds: int):
+    def on_alarm(signum, frame):
+        raise TimeoutError(f"test exceeded {seconds}s deadline")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No injected fault may leak across tests."""
+    yield
+    faultinject.configure("")
+
+
+def fixture_rows(rcv1_path):
+    with open(rcv1_path, "rb") as f:
+        return [l for l in f.read().splitlines() if l.strip()]
+
+
+def train_args(rcv1_path, model, epochs=3, extra=()):
+    # stop_rel_objv=0: the generation tests count on exactly ``epochs``
+    # interval checkpoints, so relative-loss early stop is disabled
+    return [f"data_in={rcv1_path}", "lr=1", "l1=1", "l2=1",
+            "batch_size=100", f"max_num_epochs={epochs}", "shuffle=0",
+            "num_jobs_per_epoch=1", "report_interval=0",
+            "stop_rel_objv=0", f"model_out={model}", *extra]
+
+
+@pytest.fixture(scope="module")
+def ckpt_model(rcv1_path, tmp_path_factory):
+    """A trained model WITH interval checkpoints: ``_iter-0..2_part-0``
+    (+ manifests), the final ``_part-0`` and the ``.meta`` marker — the
+    generation family the recovery tests corrupt and walk."""
+    d = tmp_path_factory.mktemp("chaos_model")
+    model = str(d / "model")
+    assert main(train_args(rcv1_path, model,
+                           extra=("ckpt_interval=1",))) == 0
+    for e in range(3):
+        assert os.path.exists(f"{model}_iter-{e}_part-0")
+        assert os.path.exists(f"{model}_iter-{e}_part-0.manifest.json")
+    return model
+
+
+def corrupt_flip(path):
+    """Flip a byte inside the 'w' array payload (past the zip member
+    name + npy header) — a bit flip the manifest digest / zip CRC must
+    catch."""
+    data = bytearray(open(path, "rb").read())
+    i = data.find(b"w.npy") + 200
+    assert i + 200 < len(data)
+    data[i] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+
+
+def corrupt_truncate(path):
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[:len(data) // 2])
+
+
+# ------------------------------------------------------ fault registry
+
+def test_faultinject_parse_fire_and_disarm():
+    from difacto_tpu.utils.faultinject import FaultInjected
+
+    with pytest.raises(ValueError, match="bad DIFACTO_FAULTS"):
+        faultinject.parse("garbage")
+    with pytest.raises(ValueError, match="unknown kind"):
+        faultinject.parse("p.x:explode@1")
+    # after_n skips N calls, fires on the N+1-th, then re-arms
+    faultinject.configure("p.x:close@1:2")
+    assert [faultinject.fire("p.x") for _ in range(6)] == \
+        [None, None, "close", None, None, "close"]
+    assert faultinject.stats() == {"p.x": 2}
+    # err raises the OSError subclass real IO paths already handle
+    faultinject.configure("p.y:err@1")
+    with pytest.raises(FaultInjected):
+        faultinject.fire("p.y")
+    assert isinstance(FaultInjected("x"), OSError)
+    # unarmed = no-op
+    faultinject.configure("")
+    assert faultinject.fire("p.y") is None and not faultinject.armed()
+
+
+def test_launch_relaunch_backoff():
+    import random
+
+    from launch import RELAUNCH_BACKOFF_CAP_S, _relaunch_delay
+    rng = random.Random(7)
+    d0 = [_relaunch_delay(0, 2.0, rng) for _ in range(50)]
+    d3 = [_relaunch_delay(3, 2.0, rng) for _ in range(50)]
+    # floored at one heartbeat timeout, exponential growth, jittered
+    assert min(d0) >= 2.0 and max(d0) <= 2.0 * 1.5
+    assert min(d3) >= 2.0 * 8 * 0.5 and max(d3) <= 2.0 * 8 * 1.5
+    assert len(set(d0)) > 1, "no jitter"
+    # capped: attempt 30 must not wait 2**30 heartbeats
+    assert _relaunch_delay(30, 2.0, rng) <= RELAUNCH_BACKOFF_CAP_S * 1.5
+
+
+# ------------------------------------------------- checkpoint verifying
+
+def test_remote_save_npz_atomic_and_torn():
+    """Satellite: remote saves upload to a .tmp key then finalize; an
+    injected torn write leaves no manifest, so the checkpoint reads as
+    incomplete instead of half-parsing."""
+    fsspec = pytest.importorskip("fsspec")
+    from difacto_tpu.utils import manifest as mft
+    from difacto_tpu.utils import stream
+
+    uri = "memory://chaos_atomic/ck.npz"
+    stream.save_npz(uri, a=np.arange(7), manifest={"generation": 1})
+    fs = fsspec.filesystem("memory")
+    names = [e.rsplit("/", 1)[-1]
+             for e in fs.ls("/chaos_atomic", detail=False)]
+    assert "ck.npz" in names and "ck.npz.manifest.json" in names
+    assert not any(n.endswith(".tmp") for n in names), names
+    with stream.load_npz(uri) as z:
+        assert z["a"].tolist() == list(range(7))
+    assert mft.verify(uri)["generation"] == 1
+
+    faultinject.configure("ckpt.write:truncate@1")
+    stream.save_npz("memory://chaos_atomic/torn.npz", a=np.arange(64),
+                    manifest={"generation": 1}, fault_point="ckpt.write")
+    assert faultinject.stats() == {"ckpt.write": 1}
+    faultinject.configure("")
+    with pytest.raises(mft.CheckpointCorrupt, match="manifest missing"):
+        mft.verify("memory://chaos_atomic/torn.npz",
+                   require_manifest=True)
+
+
+def test_corrupt_checkpoint_matrix(ckpt_model, tmp_path):
+    """Satellite: truncated npz, bit-flipped array and missing manifest
+    all surface as the typed CheckpointCorrupt, never a numpy crash."""
+    import shutil
+
+    from difacto_tpu.store.local import CheckpointCorrupt, SlotStore
+    from difacto_tpu.updaters.sgd_updater import SGDUpdaterParam
+    from difacto_tpu.utils import manifest as mft
+
+    src = f"{ckpt_model}_part-0"
+    for name, corrupt in (("trunc", corrupt_truncate),
+                          ("flip", corrupt_flip)):
+        p = str(tmp_path / name)
+        shutil.copy(src, p)
+        shutil.copy(src + mft.MANIFEST_SUFFIX, p + mft.MANIFEST_SUFFIX)
+        corrupt(p)
+        with pytest.raises(CheckpointCorrupt) as ei:
+            SlotStore(SGDUpdaterParam(V_dim=0)).load(p)
+        assert p in str(ei.value)  # names the bad file
+    # missing manifest: corruption where a manifest is required ...
+    p = str(tmp_path / "nomanifest")
+    shutil.copy(src, p)
+    with pytest.raises(CheckpointCorrupt, match="manifest missing"):
+        mft.verify(p, require_manifest=True)
+    # ... but legacy-accepted (intact npz) where it is not
+    assert mft.verify(p) is None
+    assert SlotStore(SGDUpdaterParam(V_dim=0)).load(p) > 0
+
+
+def test_pred_fails_typed_on_corrupt_model(ckpt_model, rcv1_path,
+                                           tmp_path):
+    """task=pred never falls back (predictions must come from the model
+    asked for) — it fails with the typed error naming the bad file."""
+    import shutil
+
+    from difacto_tpu.store.local import CheckpointCorrupt
+
+    model = str(tmp_path / "pmodel")
+    shutil.copy(f"{ckpt_model}_part-0", model + "_part-0")
+    shutil.copy(f"{ckpt_model}_part-0.manifest.json",
+                model + "_part-0.manifest.json")
+    corrupt_flip(model + "_part-0")
+    with pytest.raises(CheckpointCorrupt) as ei:
+        main(["task=pred", f"model_in={model}", f"data_val={rcv1_path}",
+              f"pred_out={tmp_path / 'pred'}"])
+    assert model + "_part-0" in str(ei.value)
+
+
+def test_auto_resume_walks_back_generations(ckpt_model, rcv1_path,
+                                            tmp_path):
+    """auto_resume with the two newest interval checkpoints corrupted
+    (bit flip / torn manifest-less) resumes from the oldest verified one
+    instead of crashing — no manual cleanup."""
+    import shutil
+
+    model = str(tmp_path / "model")
+    for e in range(3):
+        for suf in ("", ".manifest.json"):
+            shutil.copy(f"{ckpt_model}_iter-{e}_part-0{suf}",
+                        f"{model}_iter-{e}_part-0{suf}")
+    with open(model + ".meta", "w") as f:
+        f.write(json.dumps({"last_epoch": 2}))
+    corrupt_flip(model + "_iter-2_part-0")                # bit flip
+    corrupt_truncate(model + "_iter-1_part-0")            # torn npz ...
+    os.remove(model + "_iter-1_part-0.manifest.json")     # ... no marker
+    # resume and run one more epoch: must come back from epoch 0
+    assert main(train_args(rcv1_path, model, epochs=2,
+                           extra=("auto_resume=1",
+                                  "ckpt_interval=1"))) == 0
+    # the resumed run wrote epoch 1's checkpoint over the torn file and
+    # it verifies now
+    from difacto_tpu.utils import manifest as mft
+    assert mft.verify(model + "_iter-1_part-0",
+                      require_manifest=True) is not None
+
+
+def test_serve_falls_back_to_previous_generation(ckpt_model, tmp_path):
+    """task=serve startup with a corrupt final model walks back to the
+    newest interval generation that verifies and serves it."""
+    import shutil
+
+    from difacto_tpu.serve import open_serving_store
+
+    model = str(tmp_path / "model")
+    for e in range(3):
+        for suf in ("", ".manifest.json"):
+            shutil.copy(f"{ckpt_model}_iter-{e}_part-0{suf}",
+                        f"{model}_iter-{e}_part-0{suf}")
+    for suf in ("", ".manifest.json"):
+        shutil.copy(f"{ckpt_model}_part-0{suf}", f"{model}_part-0{suf}")
+    corrupt_flip(model + "_part-0")
+    store, meta, _ = open_serving_store(model)
+    assert meta["path"] == model + "_iter-2_part-0"
+    assert store.read_only and store.num_features > 0
+
+
+def test_ckpt_keep_prunes_old_generations(rcv1_path, tmp_path):
+    """Satellite: ckpt_keep retires old interval checkpoints (and their
+    manifests); the final model survives."""
+    model = str(tmp_path / "model")
+    assert main(train_args(rcv1_path, model, epochs=4,
+                           extra=("ckpt_interval=1",
+                                  "ckpt_keep=2"))) == 0
+    kept = sorted(f for f in os.listdir(tmp_path)
+                  if "_iter-" in f and not f.endswith(".json"))
+    assert kept == ["model_iter-2_part-0", "model_iter-3_part-0"], kept
+    assert not os.path.exists(f"{model}_iter-0_part-0.manifest.json")
+    assert os.path.exists(f"{model}_part-0")
+
+
+# ------------------------------------------------ crash + resume (leg 1)
+
+def test_sigkill_mid_checkpoint_then_auto_resume(rcv1_path, tmp_path):
+    """Acceptance leg 1: SIGKILL mid-checkpoint write (the injected
+    ``kill`` tears the file exactly like a crash mid-upload), then the
+    next run auto-resumes from the previous verified generation with no
+    manual cleanup."""
+    model = str(tmp_path / "model")
+    env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
+    args = [sys.executable, "-m", "difacto_tpu"] + train_args(
+        rcv1_path, model, extra=("ckpt_interval=1", "auto_resume=1"))
+    with deadline(240):
+        # epoch-0 checkpoint succeeds; the epoch-1 save is torn + killed
+        env["DIFACTO_FAULTS"] = "ckpt.write:kill@1:1"
+        p1 = subprocess.run(args, cwd=str(REPO), env=env,
+                            capture_output=True, text=True, timeout=200)
+        assert p1.returncode == -signal.SIGKILL, p1.stderr[-2000:]
+        # the crash left a torn epoch-1 checkpoint under the FINAL name
+        assert os.path.exists(f"{model}_iter-1_part-0")
+        assert not os.path.exists(
+            f"{model}_iter-1_part-0.manifest.json")
+        # second run: no faults; must walk past the torn file to epoch 0
+        env.pop("DIFACTO_FAULTS")
+        p2 = subprocess.run(args, cwd=str(REPO), env=env,
+                            capture_output=True, text=True, timeout=200)
+        assert p2.returncode == 0, p2.stderr[-2000:]
+        assert "auto-resumed from epoch 0" in p2.stderr
+        assert "walking back" in p2.stderr  # the torn file was seen
+
+
+# ---------------------------------------------- hot reload (leg 2)
+
+def test_hot_reload_under_load(ckpt_model, rcv1_path):
+    """Acceptance leg 2: hot-reload under ~2x steady loadgen traffic —
+    zero !err responses, model_generation advances, in-flight batches on
+    the old model still return; a corrupt reload keeps the old model."""
+    sys.path.insert(0, str(REPO / "tools"))
+    from loadgen import run_loadgen
+
+    from difacto_tpu.serve import (ModelReloader, ServeClient,
+                                   ServeServer, open_serving_store)
+    rows = fixture_rows(rcv1_path)
+    with deadline(180):
+        store, _, _ = open_serving_store(ckpt_model)
+        srv = ServeServer(store, batch_size=64, max_delay_ms=2.0).start()
+        srv.reloader = ModelReloader(srv.executor, ckpt_model)
+        rep = {}
+
+        def load():
+            # open-loop traffic throughout the swap window
+            rep.update(run_loadgen(srv.host, srv.port, rows, qps=400,
+                                   duration_s=3.0))
+
+        try:
+            t = threading.Thread(target=load)
+            t.start()
+            time.sleep(0.5)
+            with ServeClient(srv.host, srv.port) as c:
+                assert c.stats()["model_generation"] == 1
+                res = c.reload()     # same path, re-verified + swapped
+                assert res["ok"] and res["model_generation"] == 2, res
+                # a corrupt candidate is rejected; the old model serves on
+                res2 = c.reload(str(REPO / "README.md"))
+                assert not res2["ok"], res2
+                st = c.stats()
+                assert st["model_generation"] == 2
+                assert st["reloads"] == 1 and st["reload_failures"] == 1
+                assert c.predict(rows[:5]) and all(
+                    r is not None for r in c.predict(rows[:5]))
+            t.join()
+        finally:
+            srv.close()
+        assert rep["err"] == 0, rep          # zero !err through the swap
+        assert rep["ok"] > 0, rep            # old-model in-flight returned
+
+
+# ------------------------------------------------- SIGTERM drain (leg 3)
+
+def test_sigterm_drains_and_exits_zero(ckpt_model, rcv1_path, tmp_path):
+    """Acceptance leg 3: SIGTERM under open-loop load → the server stops
+    accepting, answers new rows '!shed draining', resolves admitted work
+    and exits 0 within drain_timeout_s."""
+    sys.path.insert(0, str(REPO / "tools"))
+    from loadgen import run_loadgen
+
+    ready = str(tmp_path / "ready")
+    env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
+    env.pop("DIFACTO_FAULTS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "difacto_tpu", "task=serve",
+         f"model_in={ckpt_model}", f"serve_ready_file={ready}",
+         "serve_drain_timeout_s=10", "serve_max_seconds=120"],
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        with deadline(240):
+            while not os.path.exists(ready):
+                time.sleep(0.05)
+                assert proc.poll() is None, proc.communicate()[1][-2000:]
+            host, port = open(ready).read().split()
+            rows = fixture_rows(rcv1_path)
+            rep = {}
+
+            def load():
+                rep.update(run_loadgen(host, int(port), rows, qps=300,
+                                       duration_s=4.0))
+
+            t = threading.Thread(target=load)
+            t.start()
+            time.sleep(1.0)   # mid-load
+            t0 = time.monotonic()
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=30)
+            drained_in = time.monotonic() - t0
+            t.join()
+        assert rc == 0, proc.communicate()[1][-2000:]
+        assert drained_in < 15.0, drained_in
+        # admitted rows were answered before exit; post-drain rows were
+        # shed explicitly, not silently dropped
+        assert rep["ok"] > 0, rep
+    finally:
+        if proc.poll() is None:  # pragma: no cover - deadline blew
+            proc.kill()
+            proc.wait()
+
+
+# ------------------------------------------- client retry (leg 4)
+
+def test_client_retries_through_socket_close(ckpt_model, rcv1_path):
+    """Acceptance leg 4: the server's writer drops the connection every
+    N responses (injected close); the retrying client reconnects,
+    resends the unanswered tail and eventually scores every row."""
+    from difacto_tpu.serve import (ServeClient, ServeServer,
+                                   open_serving_store)
+    rows = fixture_rows(rcv1_path)
+    with deadline(180):
+        store, _, _ = open_serving_store(ckpt_model)
+        srv = ServeServer(store, batch_size=100,
+                          max_delay_ms=50.0).start()
+        # every 31st response write tears the connection down
+        faultinject.configure("serve.sock.write:close@1:30")
+        try:
+            with ServeClient(srv.host, srv.port, retries=10,
+                             deadline_s=120.0) as c:
+                got = c.predict(rows)
+            fired = faultinject.stats()
+        finally:
+            faultinject.configure("")
+            srv.close()
+        assert fired.get("serve.sock.write", 0) >= 2, \
+            f"injected close never fired: {fired}"
+        assert len(got) == 100
+        assert all(g is not None and 0.0 < g < 1.0 for g in got)
+        # fail-fast client (retries=0) would have died on the same server
+
+    # ... and !shed is retryable while !err is not (unit-level)
+    with deadline(60):
+        store, _, _ = open_serving_store(ckpt_model)
+        srv = ServeServer(store, batch_size=8, max_delay_ms=1.0,
+                          queue_cap=1).start()
+        try:
+            with ServeClient(srv.host, srv.port, retries=4) as c:
+                # a malformed row is rejected, never retried
+                assert c.predict([b"not a row::"]) == [None]
+                assert c.stats()["errors"] >= 1
+        finally:
+            srv.close()
+
+
+def test_producer_part_fault_is_retried(rcv1_path, tmp_path):
+    """An injected producer failure rides the straggler/re-queue path:
+    training still completes and writes a loadable model."""
+    from difacto_tpu.serve import open_serving_store
+    model = str(tmp_path / "model")
+    # one producer thread + 4 parts: traversal order is serial, so
+    # after_n=3 fires exactly once (part 4's first attempt) and its
+    # retry passes — deterministic, and within max_retries=1
+    faultinject.configure("producer.part:err@1:3")
+    try:
+        with deadline(180):
+            # l1=0: one epoch over 25-row parts must leave nonzero
+            # weights to assert on (l1=1 shrinks this tiny run to zero)
+            assert main([f"data_in={rcv1_path}", "lr=1", "l1=0", "l2=1",
+                         "batch_size=25", "max_num_epochs=1", "shuffle=0",
+                         "num_jobs_per_epoch=4", "num_producers=1",
+                         "report_interval=0",
+                         f"model_out={model}"]) == 0
+    finally:
+        fired = faultinject.stats()
+        faultinject.configure("")
+    assert fired.get("producer.part", 0) > 0, \
+        "fault never fired — the test proved nothing"
+    store, _, _ = open_serving_store(model)
+    assert store.num_features > 0

@@ -71,7 +71,15 @@ def run_loadgen(host: str, port: int, rows: Sequence[Line], qps: float,
                 continue
             with ts_lock:
                 send_ts.append(time.monotonic())
-            sock.sendall(rows[i % len(rows)])
+            try:
+                sock.sendall(rows[i % len(rows)])
+            except OSError:
+                # the server dropped the connection (drain/shutdown
+                # mid-run): stop offering, let the receiver tally what
+                # came back — rows past this point were never sent
+                with ts_lock:
+                    send_ts.pop()
+                break
             sent += 1
             i += 1
             # exponential gaps: Poisson arrivals at the target rate.
