@@ -6,8 +6,12 @@
 #   make ci     - both
 
 PY ?= python
+# obs-report inputs: the metrics JSONL a run wrote (metrics_path knob)
+# and optionally its Chrome trace (DIFACTO_TRACE)
+METRICS ?= run.metrics.jsonl
+TRACE ?=
 
-.PHONY: test smoke ci
+.PHONY: test smoke ci obs-report
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -22,3 +26,8 @@ smoke:
 	print('entry + dryrun ok')"
 
 ci: test smoke
+
+# human summary of a run's observability artifacts (docs/observability.md):
+#   make obs-report METRICS=run.metrics.jsonl TRACE=run.trace.json
+obs-report:
+	$(PY) tools/obs_report.py --metrics $(METRICS) $(if $(TRACE),--trace $(TRACE))

@@ -268,9 +268,13 @@ def run_e2e(args) -> dict:
             "vs_baseline": round(streamed / REF_PSLITE_32W_EPS, 3),
             "epochs_timed": streamed_epochs - 1,
             # which producer transport ran, and where the run's seconds
-            # went (whole-run totals incl. epoch 0): a future streamed
-            # regression localizes to pack vs transfer vs step instead
-            # of hiding in the headline (ISSUE 1 satellite)
+            # went (whole-run totals incl. epoch 0), SOURCED FROM THE OBS
+            # REGISTRY (learner.stage_stats over stage_seconds_total —
+            # ISSUE 4): parse/pack/ring-wait arrive from the producer
+            # worker processes through their snapshot channel, so the
+            # breakdown survives the process boundary and a streamed
+            # regression localizes to a stage instead of hiding in the
+            # headline
             "producer_mode": streamed_stages.pop("producer_mode"),
             "stages": streamed_stages,
         },

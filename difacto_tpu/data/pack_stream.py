@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Iterator, Optional, Sequence
 
@@ -221,6 +222,27 @@ class StreamSpec:
     stream_chunk: bool
     need_label: bool
     caps: dict = field(default_factory=dict)
+    # the consumer's trace id (obs/trace.py): spawned workers adopt it so
+    # their parse/pack spans join the parent's timeline in one trace file
+    trace_id: int = 0
+
+
+def timed_reader(it: Iterator, parse_c, part: int) -> Iterator:
+    """Yield from ``it`` accounting each blocking ``next`` to the PARSE
+    stage (a counter of seconds + one trace span per batch) — the read +
+    parse half of the pipeline, as opposed to the pack half timed at the
+    prepare call. One definition for threads and worker processes, so
+    bench's stage table means the same thing in both transports."""
+    from ..obs import trace
+    it = iter(it)
+    while True:
+        t0 = time.perf_counter()
+        with trace.span("producer.parse", part=part):
+            item = next(it, None)
+        parse_c.inc(time.perf_counter() - t0)
+        if item is None:
+            return
+        yield item
 
 
 def spec_iter(spec: StreamSpec, part_i: int) -> Iterator:
@@ -228,7 +250,23 @@ def spec_iter(spec: StreamSpec, part_i: int) -> Iterator:
     payload) items the learner's thread-mode make_iter produces for the
     hashed fast path, deterministically (seeded per (epoch, part) — the
     retry/re-issue contract). Heavy imports happen here, in the worker,
-    after its env overrides are applied."""
+    after its env overrides are applied.
+
+    Instrumented against the worker's process-global obs registry
+    (stage_seconds_total{stage=parse|pack}, producer rows/batches); the
+    pool ships its snapshot back to the consumer (obs/proc.py), which is
+    how the stage decomposition survives the process boundary."""
+    from ..obs import REGISTRY, trace
+    if spec.trace_id:
+        trace.set_trace_id(spec.trace_id)
+    stage = REGISTRY.counter(
+        "stage_seconds_total",
+        "seconds spent per streamed-pipeline stage, summed over threads")
+    parse_c, pack_c = stage.labels(stage="parse"), stage.labels(stage="pack")
+    rows_c = REGISTRY.counter("producer_rows_total",
+                              "rows produced by the streamed pipeline")
+    batches_c = REGISTRY.counter("producer_batches_total",
+                                 "batches produced by the streamed pipeline")
     shapes = ShapeSchedule()
     shapes.absorb(spec.caps)
     part = spec.parts[part_i]
@@ -239,6 +277,13 @@ def spec_iter(spec: StreamSpec, part_i: int) -> Iterator:
         return BlkInfo(size=blk.size,
                        label=blk.label if spec.need_label else None)
 
+    def packed(fn, *args, **kw):
+        t0 = time.perf_counter()
+        with trace.span("producer.pack", part=part):
+            out = fn(*args, **kw)
+        pack_c.inc(time.perf_counter() - t0)
+        return out
+
     if spec.cached_uri is not None:
         from .cached import CachedBatchReader
         rdr = CachedBatchReader(
@@ -247,10 +292,12 @@ def spec_iter(spec: StreamSpec, part_i: int) -> Iterator:
             neg_sampling=spec.neg_sampling,
             seed=spec.epoch * max(g_num, 1) + g_idx,
             need_counts=spec.fill_counts)
-        for sub, uniq, cnts in rdr:
-            yield ("ready", info(sub), prepare_from_uniq(
-                shapes, spec.hash_capacity, sub, uniq, cnts,
-                spec.want_counts, spec.fill_counts, spec.dim_min,
+        for sub, uniq, cnts in timed_reader(rdr, parse_c, part):
+            rows_c.inc(sub.size)
+            batches_c.inc()
+            yield ("ready", info(sub), packed(
+                prepare_from_uniq, shapes, spec.hash_capacity, sub, uniq,
+                cnts, spec.want_counts, spec.fill_counts, spec.dim_min,
                 spec.job, spec.b_cap, stream_chunk=spec.stream_chunk))
         return
     from .batch_reader import BatchReader
@@ -258,8 +305,10 @@ def spec_iter(spec: StreamSpec, part_i: int) -> Iterator:
                          spec.batch_size, spec.batch_size * spec.shuffle,
                          spec.neg_sampling,
                          seed=spec.epoch * max(g_num, 1) + g_idx)
-    for blk in reader:
-        yield ("ready", info(blk), prepare_hashed(
-            shapes, spec.hash_capacity, blk, spec.want_counts,
-            spec.fill_counts, spec.dim_min, spec.job, spec.b_cap,
-            stream_chunk=spec.stream_chunk))
+    for blk in timed_reader(reader, parse_c, part):
+        rows_c.inc(blk.size)
+        batches_c.inc()
+        yield ("ready", info(blk), packed(
+            prepare_hashed, shapes, spec.hash_capacity, blk,
+            spec.want_counts, spec.fill_counts, spec.dim_min, spec.job,
+            spec.b_cap, stream_chunk=spec.stream_chunk))

@@ -59,7 +59,10 @@ class OrderedProducerPool:
 
     def __init__(self, n_parts: int, make_iter: Callable[[int], Iterator],
                  n_workers: int = 2, depth: int = 4,
-                 pool: Optional[WorkloadPool] = None, max_retries: int = 1):
+                 pool: Optional[WorkloadPool] = None, max_retries: int = 1,
+                 obs_registry=None):
+        from ..obs import REGISTRY
+        self._obs = obs_registry if obs_registry is not None else REGISTRY
         self.n_parts = n_parts
         self.make_iter = make_iter
         self.n_workers = max(1, min(n_workers, n_parts))
@@ -152,6 +155,10 @@ class OrderedProducerPool:
                     self.pool.finish(node)
             except BaseException as e:  # re-queue, escalate if persistent
                 self._fail_counts[part] += 1
+                self._obs.counter(
+                    "producer_part_retries_total",
+                    "producer part attempts that failed and were "
+                    "re-queued (or escalated)").inc()
                 if self._fail_counts[part] > self.max_retries:
                     self._errors.append(e)
                     self._deliver(part, node, my_gen, _END)
@@ -181,6 +188,9 @@ class OrderedProducerPool:
 # Process-based producers: the same pool contract, across the GIL boundary.
 # --------------------------------------------------------------------------
 
+_STOP_ITER = object()
+
+
 def _pp_worker_main(worker_id: int, make_iter_bytes: bytes, ring_desc,
                     free_q, cmd_q, done_q, stop_ev, env: dict) -> None:
     """Worker-process entry point (module-level: spawn pickles a reference).
@@ -193,12 +203,34 @@ def _pp_worker_main(worker_id: int, make_iter_bytes: bytes, ring_desc,
     is what pulls in the heavy imports (numpy/jax via the packing helpers),
     so a worker on a TPU host comes up as a CPU-only process instead of
     fighting the consumer for the chip.
+
+    Observability: the worker instruments against its own process-global
+    registry (spec_iter accounts parse/pack; this loop accounts ring-slot
+    waits) and publishes a cumulative snapshot + collected trace spans
+    through ``done_q`` after every finished part and on exit
+    (obs/proc.py) — that is how per-stage seconds survive the process
+    boundary into the consumer's stage table.
     """
     os.environ.update(env or {})
     import traceback
 
     from .shm_ring import ShmRing, SlotOverflow
     make_iter = pickle.loads(make_iter_bytes)
+    from ..obs import REGISTRY, proc, trace
+    ring_wait_c = REGISTRY.counter(
+        "stage_seconds_total",
+        "seconds spent per streamed-pipeline stage, summed over threads"
+    ).labels(stage="ring_wait")
+    ring_wait_h = REGISTRY.histogram(
+        "ring_slot_wait_seconds",
+        "producer wait for a free shm-ring slot (the backpressure point)")
+
+    def publish() -> None:
+        try:
+            done_q.put(("obs", worker_id, proc.publish_blob()))
+        except (ValueError, OSError):  # pragma: no cover - queue closed
+            pass
+
     ring = ShmRing.attach(ring_desc)
     try:
         while not stop_ev.is_set():
@@ -214,22 +246,29 @@ def _pp_worker_main(worker_id: int, make_iter_bytes: bytes, ring_desc,
                 n = start
                 while True:
                     t0 = time.perf_counter()
-                    try:
-                        item = next(it)
-                    except StopIteration:
+                    item = next(it, _STOP_ITER)
+                    if item is _STOP_ITER:
                         break
                     pack_dt = time.perf_counter() - t0
+                    span = trace.last_span_id()
                     slot = None
-                    while not stop_ev.is_set():  # backpressure point
-                        try:
-                            slot = free_q.get(timeout=0.1)
-                            break
-                        except queue.Empty:
-                            continue
+                    t_wait = time.perf_counter()
+                    with trace.span("producer.ring_wait", part=part,
+                                    seq=n):
+                        while not stop_ev.is_set():  # backpressure point
+                            try:
+                                slot = free_q.get(timeout=0.1)
+                                break
+                            except queue.Empty:
+                                continue
+                    wait_dt = time.perf_counter() - t_wait
+                    ring_wait_c.inc(wait_dt)
+                    ring_wait_h.observe(wait_dt)
                     if slot is None:
                         return  # stopping
                     try:
-                        ring.write(slot, item, part=part, seq=n, gen=gen)
+                        ring.write(slot, item, part=part, seq=n, gen=gen,
+                                   span=span)
                         done_q.put(("item", worker_id, part, gen, n, slot,
                                     None, pack_dt))
                     except SlotOverflow:
@@ -244,10 +283,13 @@ def _pp_worker_main(worker_id: int, make_iter_bytes: bytes, ring_desc,
                     n += 1
                 if not stop_ev.is_set():
                     done_q.put(("end", worker_id, part, gen, n))
+                    publish()
             except BaseException:
                 done_q.put(("err", worker_id, part, gen,
                             traceback.format_exc()))
+                publish()
     finally:
+        publish()
         ring.close()
 
 
@@ -292,10 +334,16 @@ class ProcessProducerPool:
                  n_workers: int = 2, depth: int = 4,
                  pool: Optional[WorkloadPool] = None, max_retries: int = 1,
                  slot_bytes: int = 8 << 20, worker_env: Optional[dict] = None,
-                 join_timeout: float = 5.0):
+                 join_timeout: float = 5.0, obs_registry=None):
         import multiprocessing as mp
 
+        from ..obs import REGISTRY, proc as obs_proc
         from .shm_ring import ShmRing
+        # workers publish registry snapshots through done_q; they attach
+        # here (keyed per worker) and fold into the base at shutdown, so
+        # the consumer's registry reports exact cross-process totals
+        self._obs = obs_registry if obs_registry is not None else REGISTRY
+        self._obs_key = None  # set once the ring name exists
         self.n_parts = n_parts
         self.n_workers = max(1, min(n_workers, n_parts))
         self.depth = max(2, depth)
@@ -305,8 +353,11 @@ class ProcessProducerPool:
         self.max_retries = max_retries
         self._join_timeout = join_timeout
         # JAX_PLATFORMS=cpu by default: workers do host work only and must
-        # never bind the accelerator (callers may override/extend)
-        self._env = {"JAX_PLATFORMS": "cpu"}
+        # never bind the accelerator (callers may override/extend).
+        # DIFACTO_OBS_CHILD marks the worker as an obs child: it collects
+        # trace spans in memory and ships them through done_q instead of
+        # installing its own trace-file writer (obs/trace.py)
+        self._env = {"JAX_PLATFORMS": "cpu", obs_proc.CHILD_ENV: "1"}
         self._env.update(worker_env or {})
         self._ctx = mp.get_context("spawn")  # JAX state must never fork
         self._ring = ShmRing(n_slots=self.n_workers * self.depth,
@@ -331,8 +382,10 @@ class ProcessProducerPool:
             for w in range(self.n_workers)
         ]
         self._last_lease = None
+        self._obs_key = ("ppworker", self._ring.name)
         self.pack_s = 0.0          # producer-side seconds, summed
         self.overflow_items = 0    # items that missed the ring (pickled)
+        self.last_producer_span = 0  # trace span that packed the last item
         self._finished = False
 
     # ------------------------------------------------------------- API
@@ -377,7 +430,15 @@ class ProcessProducerPool:
                 self._ring.release(slot)
 
         def handle(msg) -> None:
-            kind, w, part, g = msg[:4]
+            kind = msg[0]
+            if kind == "obs":
+                # a worker's cumulative registry snapshot + trace spans
+                # (obs/proc.py): keep the newest per worker
+                from ..obs import proc as obs_proc
+                obs_proc.absorb_blob(self._obs, self._obs_key + (msg[1],),
+                                     msg[2])
+                return
+            _, w, part, g = msg[:4]
             if kind in ("item", "ovf"):
                 _, _, _, _, seq, slot, blob, pack_dt = msg
                 self.pack_s += pack_dt
@@ -389,15 +450,21 @@ class ProcessProducerPool:
                 if g != gen[part] or complete[part]:
                     drop(slot)  # superseded attempt — exactly-once guard
                     return
+                span = 0
                 if slot >= 0:
                     from .shm_ring import SlotLease
+                    _, _, _, span = self._ring.read_header(slot)
                     item, _, _, _ = self._ring.read(slot)
                     lease = SlotLease(self._ring, slot)
                 else:
                     item, lease = pickle.loads(blob), None
                     self.overflow_items += 1
+                    self._obs.counter(
+                        "producer_overflow_total",
+                        "items too large for a ring slot (pickled "
+                        "fallback)").inc()
                 accepted[part] += 1
-                buffers[part].append((item, lease))
+                buffers[part].append((item, lease, span))
             elif kind == "end":
                 if g == gen[part]:
                     complete[part] = True
@@ -408,6 +475,10 @@ class ProcessProducerPool:
                 tb = msg[4]
                 if g == gen[part]:
                     fail_counts[part] += 1
+                    self._obs.counter(
+                        "producer_part_retries_total",
+                        "producer part attempts that failed and were "
+                        "re-queued (or escalated)").inc()
                     if fail_counts[part] > self.max_retries:
                         errors[part] = RuntimeError(
                             f"producer worker failed part {part} "
@@ -439,12 +510,13 @@ class ProcessProducerPool:
         cur = 0
         while cur < n:
             if buffers[cur]:
-                item, lease = buffers[cur].pop(0)
+                item, lease, span = buffers[cur].pop(0)
                 if self._last_lease is not None:
                     # consumer didn't pop the previous lease: items are
                     # valid for one iteration by default
                     self._last_lease.release()
                 self._last_lease = lease
+                self.last_producer_span = span
                 yield cur, item
                 continue
             if complete[cur]:
@@ -471,9 +543,9 @@ class ProcessProducerPool:
                 # current part; without this the ring deadlocks.
                 from .shm_ring import materialize_item
                 for pbuf in buffers:
-                    for j, (it_, lease) in enumerate(pbuf):
+                    for j, (it_, lease, span_) in enumerate(pbuf):
                         if lease is not None:
-                            pbuf[j] = (materialize_item(it_), None)
+                            pbuf[j] = (materialize_item(it_), None, span_)
                             lease.release()
             pump(timeout=0.1)
         self._finished = True
@@ -492,6 +564,9 @@ class ProcessProducerPool:
                 any_alive = True
                 continue
             dead[w] = True
+            self._obs.counter(
+                "producer_worker_deaths_total",
+                "producer worker processes that died mid-run").inc()
             wp = self._worker_part[w]
             self._worker_part[w] = None
             if wp is not None:
@@ -527,13 +602,21 @@ class ProcessProducerPool:
                 if p.is_alive():
                     p.kill()
                     p.join(timeout=1.0)
-        # drain pending queue items so their feeder threads release, then
-        # drop the segment — unlink is idempotent and atexit-backed, so
-        # no /dev/shm entry survives any exit path
+        # drain pending queue items so their feeder threads release —
+        # absorbing any final obs snapshots the workers published on
+        # their way out — then drop the segment; unlink is idempotent
+        # and atexit-backed, so no /dev/shm entry survives any exit path
+        from ..obs import proc as obs_proc
         for dq in self._done_qs:
             try:
                 while True:
-                    dq.get_nowait()
+                    msg = dq.get_nowait()
+                    if msg and msg[0] == "obs":
+                        obs_proc.absorb_blob(
+                            self._obs, self._obs_key + (msg[1],), msg[2])
             except (queue.Empty, ValueError, OSError):
                 pass
         self._ring.unlink()
+        # retire the per-worker snapshots into the base series so the
+        # totals survive this pool object (and accumulate across epochs)
+        self._obs.fold_children(self._obs_key)

@@ -15,10 +15,13 @@ Slot layout (one slot = ``slot_bytes`` of the segment)::
 
     [array 0 bytes | pad to 64 | array 1 bytes | ...]   from offset 0
     [pickled meta][ meta_len u32 | part u32 | seq u32 |
-                    gen u32 | payload u64 ]             tail header
+                    gen u32 | span u32 | payload u64 ]  tail header
 
 The tail header carries the item identity (part id, seq no, attempt
-generation) and the pickled meta — the item's structure with every array
+generation), the PRODUCER'S trace span id (``span`` — the obs/trace.py
+span that packed this item, so the consumer's unpack/step spans can
+point at the exact producer span that built their batch across the
+process boundary) and the pickled meta — the item's structure with every array
 replaced by a (shape, dtype, offset) placeholder — so a slot is fully
 self-describing: the consumer rebuilds the exact item object from the
 slot alone.
@@ -50,7 +53,8 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-_HEADER = struct.Struct("<IIIIQ")  # meta_len, part, seq, gen, payload_bytes
+# meta_len, part, seq, gen, producer span id, payload_bytes
+_HEADER = struct.Struct("<IIIIIQ")
 _ALIGN = 64
 
 # live rings created by THIS process, for the atexit safety net
@@ -213,9 +217,11 @@ class ShmRing:
 
     # ----------------------------------------------------------- write
     def write(self, slot: int, item: Any, part: int, seq: int,
-              gen: int) -> None:
-        """Encode ``item`` into ``slot``. Raises :class:`SlotOverflow`
-        (leaving the slot reusable) when it does not fit."""
+              gen: int, span: int = 0) -> None:
+        """Encode ``item`` into ``slot``. ``span`` is the producer-side
+        trace span id riding the header (0 = tracing off). Raises
+        :class:`SlotOverflow` (leaving the slot reusable) when it does
+        not fit."""
         arrays: List[np.ndarray] = []
         spec = encode_item(item, arrays)
         offs = []
@@ -239,16 +245,24 @@ class ShmRing:
         end = base + self.slot_bytes
         buf[end - _HEADER.size - len(meta):end - _HEADER.size] = meta
         _HEADER.pack_into(buf, end - _HEADER.size, len(meta), part, seq,
-                          gen, off)
+                          gen, span & 0xFFFFFFFF, off)
 
     # ------------------------------------------------------------ read
+    def read_header(self, slot: int) -> Tuple[int, int, int, int]:
+        """(part, seq, gen, producer_span) without decoding the item —
+        the consumer's cross-process span linkage (obs/trace.py)."""
+        end = (slot + 1) * self.slot_bytes
+        _, part, seq, gen, span, _ = _HEADER.unpack_from(
+            self._shm.buf, end - _HEADER.size)
+        return part, seq, gen, span
+
     def read(self, slot: int) -> Tuple[Any, int, int, int]:
         """(item, part, seq, gen) — the item's arrays are zero-copy views
         into the slot; hold the lease until done with them."""
         base = slot * self.slot_bytes
         end = base + self.slot_bytes
         buf = self._shm.buf
-        meta_len, part, seq, gen, _ = _HEADER.unpack_from(
+        meta_len, part, seq, gen, _span, _ = _HEADER.unpack_from(
             buf, end - _HEADER.size)
         spec, placements = pickle.loads(
             bytes(buf[end - _HEADER.size - meta_len:end - _HEADER.size]))

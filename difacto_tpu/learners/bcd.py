@@ -467,11 +467,22 @@ class BCDLearner(Learner):
             self.load(p.model_in)
         order = np.arange(len(self.blocks))
         rng = np.random.RandomState(p.seed)
+        import time as _time
+
+        from ..obs import REGISTRY, trace
+        step_h = REGISTRY.histogram(
+            "train_step_seconds",
+            "host-side dispatch+wait time of one fused device step"
+        ).labels(learner="bcd")
         for epoch in range(p.max_num_epochs):
             if p.random_block:
                 rng.shuffle(order)
-            for f in order:
-                self._iterate_block(int(f))
+            with trace.span("epoch", epoch=epoch, learner="bcd"):
+                for f in order:
+                    t0 = _time.perf_counter()
+                    with trace.span("bcd.block", block=int(f)):
+                        self._iterate_block(int(f))
+                    step_h.observe(_time.perf_counter() - t0)
             prog = self._progress()
             log.info("epoch: %d, objv: %g, auc: %g, acc: %g, nnz(w): %d",
                      epoch, prog.objv / max(prog.count, 1),

@@ -430,10 +430,22 @@ class LBFGSLearner(Learner):
         grad = self._put_vec(jnp.zeros(self.N_pad, dtype=jnp.float32))
         objv = 0.0
         auc = 0.0
+        # obs: per-tile device step time into the shared histogram type
+        # (one quantile definition across sgd/bcd/lbfgs/serve)
+        import time as _time
+
+        from ..obs import REGISTRY, trace
+        step_h = REGISTRY.histogram(
+            "train_step_seconds",
+            "host-side dispatch+wait time of one fused device step"
+        ).labels(learner="lbfgs")
         for tile in self._iter_tiles("train"):
-            o, a, grad = self._tile_grad(weights, grad, tile)
-            objv += float(o)
-            auc += float(a)
+            t0 = _time.perf_counter()
+            with trace.span("lbfgs.tile_grad"):
+                o, a, grad = self._tile_grad(weights, grad, tile)
+                objv += float(o)
+                auc += float(a)
+            step_h.observe(_time.perf_counter() - t0)
         if self._num_hosts > 1:
             from ..parallel.multihost import allreduce_np
             # scalars ride a float64-safe wire; the gradient gathers as

@@ -298,6 +298,12 @@ class SGDLearnerParam(Param):
     # and bucket per batch.
     nnz_cap: int = 0
     uniq_cap: int = 0
+    # observability (difacto_tpu/obs): append a JSONL snapshot of the
+    # run's metric registry to this path every metrics_interval_s (plus a
+    # final flush at run end); "" disables. tools/obs_report.py renders
+    # the log; DIFACTO_TRACE=<path> additionally captures span timelines.
+    metrics_path: str = ""
+    metrics_interval_s: float = 30.0
 
 
 @register("sgd")
@@ -340,14 +346,37 @@ class SGDLearner(Learner):
             raise ValueError(
                 f"unknown producer_mode {self.param.producer_mode!r} "
                 "(expected auto|thread|process)")
-        # streamed-epoch stage decomposition (bench.py e2e.streamed.stages):
-        # pack_s    = producer-side pipeline seconds (threads or processes)
-        # transfer_s= host->device staging of packed buffers
-        # step_s    = step dispatch + the metric-fetch waits where device
-        #             time surfaces
-        self._stage_acc = {"pack_s": 0.0, "transfer_s": 0.0, "step_s": 0.0}
-        self._stage_lock = threading.Lock()
+        # observability (difacto_tpu/obs): each learner instance keeps its
+        # OWN registry so stage totals are attributable to this run (two
+        # learners in one process — bench's replay + streamed windows —
+        # must not blur together); producer worker processes report into
+        # it through the pool's snapshot channel (obs/proc.py). The
+        # streamed-epoch stage decomposition lives in
+        # stage_seconds_total{stage}:
+        #   parse    = read+parse half of the producer pipeline
+        #   pack     = localize/slot-map/pack half
+        #   ring_wait= producer blocked on a free shm-ring slot
+        #   transfer = host->device staging of packed buffers
+        #   step     = step dispatch + the metric-fetch waits where
+        #              device time surfaces
+        # bench.py's e2e.streamed.stages is stage_stats() over this
+        # registry — no private timers.
+        from ..obs import Registry
+        self.obs = Registry()
+        stage_c = self.obs.counter(
+            "stage_seconds_total",
+            "seconds spent per streamed-pipeline stage, summed over "
+            "threads")
+        self._stage_c = {k: stage_c.labels(stage=k)
+                         for k in ("parse", "pack", "ring_wait",
+                                   "transfer", "step")}
+        self._step_h = self.obs.histogram(
+            "train_step_seconds",
+            "host-side dispatch+wait time of one fused device step")
+        self._rows_c = self.obs.counter(
+            "train_rows_total", "examples consumed by dispatched steps")
         self._last_producer_mode = "thread"
+        self._flusher = None
         self._shapes = _ShapeSchedule()
         # job types whose data THIS process has fully passed over once —
         # after that the SPMD dictionary exchange ships slots instead of
@@ -533,6 +562,14 @@ class SGDLearner(Learner):
         """RunScheduler (sgd_learner.cc:52-122)."""
         p = self.param
         self._start_time = time.time()
+        if p.metrics_path and self._flusher is None:
+            # periodic JSONL export of this run's registry + the
+            # process-global one (faults, DCN counters); final flush +
+            # trace save happen in stop()
+            from ..obs import REGISTRY, MetricsFlusher
+            self._flusher = MetricsFlusher(
+                p.metrics_path, p.metrics_interval_s,
+                registries=[self.obs, REGISTRY]).start()
         self._report = ReportProg()
         # live nnz(w)/penalty flow through the Reporter contract
         # (include/difacto/reporter.h:14-56): the part cadence reports a
@@ -610,9 +647,21 @@ class SGDLearner(Learner):
                 # written last (by host 0) so a crash mid-save resumes
                 # from the previous complete epoch
                 self.store.save(self._model_name(p.model_out, k),
-                                save_aux=True, epoch=k, keep=p.ckpt_keep)
+                                save_aux=True, epoch=k)
                 if self._host_rank == 0:
                     self._write_ckpt_meta(k)
+                    if p.ckpt_keep > 0:
+                        # rank 0 prunes the WHOLE generation family
+                        # (every rank's _iter-* parts via the meta+glob
+                        # scan) — per-rank pruning left an evicted
+                        # rank's stale parts behind forever, since the
+                        # rank that wrote them is gone (ROADMAP leftover
+                        # from PR 3). Safe concurrently with peers still
+                        # writing: only epochs older than the newest
+                        # ckpt_keep are removed, and no rank rewrites an
+                        # old generation.
+                        from ..utils import manifest as mft
+                        mft.prune_checkpoints(p.model_out, p.ckpt_keep)
 
             # stop criteria (sgd_learner.cc:92-110): the reference divides by
             # pre_loss with no zero guard — first epoch never triggers
@@ -643,6 +692,9 @@ class SGDLearner(Learner):
         if self._fo_pred is not None:
             self._fo_pred.close()
             self._fo_pred = None
+        if self._flusher is not None:
+            self._flusher.close()
+            self._flusher = None
 
     # ----------------------------------------------------------- epochs
     def _model_name(self, prefix: str, it: int) -> str:
@@ -716,6 +768,12 @@ class SGDLearner(Learner):
         return None
 
     def _run_epoch(self, epoch: int, job_type: int, prog: Progress) -> None:
+        from ..obs import trace
+        with trace.span("epoch", epoch=epoch, job=job_type):
+            self._run_epoch_body(epoch, job_type, prog)
+
+    def _run_epoch_body(self, epoch: int, job_type: int,
+                        prog: Progress) -> None:
         p = self.param
         n_jobs = p.num_jobs_per_epoch if job_type == K_TRAINING else 1
         if self._num_hosts > 1 and self.mesh is not None:
@@ -1157,6 +1215,8 @@ class SGDLearner(Learner):
                 # so store-state mutations stay ordered with the steps
                 self.store.state = self._apply_count(
                     self.store.state, slots_dev, cts_dev)
+            from ..step import fire_step_fault
+            fire_step_fault()
             if job_type == K_TRAINING:
                 self.store.state, objv, auc = self._train_step(
                     self.store.state, batch, slots_dev)
@@ -1293,6 +1353,7 @@ class SGDLearner(Learner):
         vals = np.asarray(flat)  # the sync point where device time lands
         self._add_stage("step_s", time.perf_counter() - t0)
         for i, (nrows, _, _) in enumerate(pending):
+            self._rows_c.inc(nrows)
             prog.merge(Progress(nrows=nrows, loss=float(vals[2 * i]),
                                 auc=float(vals[2 * i + 1])))
         return [float(v) for v in vals[2 * len(pending):]]
@@ -1364,18 +1425,27 @@ class SGDLearner(Learner):
         return out
 
     # ------------------------------------------------ streamed pipeline
+    _STAGE_KEYS = ("parse_s", "pack_s", "ring_wait_s", "transfer_s",
+                   "step_s")
+
     def _add_stage(self, key: str, dt: float) -> None:
-        with self._stage_lock:
-            self._stage_acc[key] += dt
+        # key is the legacy "<stage>_s" form; the value lands in the
+        # registry counter stage_seconds_total{stage} (per-thread cells,
+        # so producer threads report without contention)
+        self._stage_c[key[:-2]].inc(dt)
 
     def stage_stats(self) -> dict:
-        """Streamed-epoch stage decomposition accumulated over the run
-        (pack / transfer / step seconds) plus the producer transport that
-        ran — bench.py emits this as ``e2e.streamed.stages`` so a
+        """Streamed-epoch stage decomposition accumulated over the run —
+        read from THE OBS REGISTRY (stage_seconds_total{stage}), so the
+        numbers include what producer worker processes reported across
+        the process boundary (obs/proc.py) — plus the producer transport
+        that ran. bench.py emits this as ``e2e.streamed.stages`` so a
         streamed regression localizes to a stage instead of hiding in
         the headline rate."""
-        with self._stage_lock:
-            out = {k: round(v, 3) for k, v in self._stage_acc.items()}
+        snap = self.obs.snapshot()
+        series = snap.get("counters", {}).get("stage_seconds_total", {})
+        vals = {dict(k).get("stage", ""): v for k, v in series.items()}
+        out = {k: round(vals.get(k[:-2], 0.0), 3) for k in self._STAGE_KEYS}
         out["producer_mode"] = self._last_producer_mode
         return out
 
@@ -1694,6 +1764,20 @@ class SGDLearner(Learner):
         stream_chunk = (is_train and hashed_fast and p.stream_chunks
                         and not cache_may_stage)
 
+        from ..data.pack_stream import timed_reader
+        from ..obs import trace
+        parse_c, pack_c = self._stage_c["parse"], self._stage_c["pack"]
+
+        def packed(part, fn, *args, **kw):
+            # pack-stage accounting (the thread-mode twin of
+            # pack_stream.spec_iter's instrumentation): one counter inc
+            # + one trace span per prepared batch, on the producer thread
+            t0 = time.perf_counter()
+            with trace.span("producer.pack", part=part):
+                out = fn(*args, **kw)
+            pack_c.inc(time.perf_counter() - t0)
+            return out
+
         def make_iter(part):
             # EVERYTHING host-side happens on producer threads so it
             # overlaps device execution. Hashed mode is stateless (no
@@ -1710,26 +1794,27 @@ class SGDLearner(Learner):
                     neg_sampling=p.neg_sampling if is_train else 1.0,
                     seed=epoch * max(g_num, 1) + g_idx,
                     need_counts=push_cnt)
-                for sub, uniq, cnts in rdr:
+                for sub, uniq, cnts in timed_reader(rdr, parse_c, part):
                     if hashed_fast:
-                        yield ("ready", sub, self._prepare_from_uniq(
-                            sub, uniq, cnts, want_counts, push_cnt,
-                            dim_min, job,
+                        yield ("ready", sub, packed(
+                            part, self._prepare_from_uniq, sub, uniq,
+                            cnts, want_counts, push_cnt, dim_min, job,
                             b_cap_train if is_train else None,
                             stream_chunk=stream_chunk))
                     else:
                         yield ("compact", sub, (sub, uniq, cnts))
                 return
             reader = self._make_reader(job_type, epoch, g_idx, g_num)
-            for blk in reader:
+            for blk in timed_reader(reader, parse_c, part):
                 if hashed_fast:
-                    yield ("ready", blk, self._prepare_hashed(
-                        blk, want_counts, push_cnt, dim_min, job,
+                    yield ("ready", blk, packed(
+                        part, self._prepare_hashed, blk, want_counts,
+                        push_cnt, dim_min, job,
                         b_cap_train if is_train else None,
                         stream_chunk=stream_chunk))
                 else:
-                    yield ("compact", blk, compact(blk,
-                                                   need_counts=push_cnt))
+                    yield ("compact", blk, packed(
+                        part, compact, blk, need_counts=push_cnt))
 
         from ..data.producer_pool import (OrderedProducerPool,
                                           ProcessProducerPool)
@@ -1762,35 +1847,26 @@ class SGDLearner(Learner):
                 want_counts=want_counts, fill_counts=push_cnt,
                 dim_min=dim_min, job=job, b_cap=b_cap_train,
                 stream_chunk=stream_chunk, need_label=False,
-                caps=self._shapes.snapshot())
+                caps=self._shapes.snapshot(),
+                trace_id=trace.trace_id())
             slot_mb = p.ring_slot_mb or max(
                 1, (p.batch_size * 320) >> 20)
+            # obs_registry: workers report their parse/pack/ring-wait
+            # seconds into THIS learner's registry through the pool's
+            # snapshot channel — stage_stats() then spans both processes
             pool = ProcessProducerPool(
                 len(stream_parts), functools.partial(spec_iter, spec),
                 n_workers=n_workers, depth=p.producer_depth, pool=wp,
-                slot_bytes=slot_mb << 20)
+                slot_bytes=slot_mb << 20, obs_registry=self.obs)
         else:
             # the pool runs over the parts still streamed this epoch (all
             # of them, unless a partial cache replayed a prefix above);
-            # logical pool indices map back to actual part ids for
-            # reporting/staging
-
-            def timed_make_iter(i):
-                it = make_iter(stream_parts[i])
-                while True:
-                    t0 = time.perf_counter()
-                    try:
-                        item = next(it)
-                    except StopIteration:
-                        self._add_stage("pack_s",
-                                        time.perf_counter() - t0)
-                        return
-                    self._add_stage("pack_s", time.perf_counter() - t0)
-                    yield item
-
+            # logical pool indices map back to actual part ids —
+            # make_iter instruments its own parse/pack stages
             pool = OrderedProducerPool(
-                len(stream_parts), timed_make_iter,
-                n_workers=n_workers, depth=p.producer_depth, pool=wp)
+                len(stream_parts), lambda i: make_iter(stream_parts[i]),
+                n_workers=n_workers, depth=p.producer_depth, pool=wp,
+                obs_registry=self.obs)
         pending: list = []
         cur_part = stream_parts[0] if stream_parts else 0
         reports = self._part_reports(job_type)
@@ -1824,8 +1900,21 @@ class SGDLearner(Learner):
             if use_process:
                 self._absorb_payload_caps(job, item)
             n_before = len(pending)
-            self._dispatch_item(job_type, item, push_cnt, want_counts, job,
-                                dim_min, pending, cache=cache, part=cur_part)
+            if trace.active():
+                # consumer-side span pointing at the exact producer span
+                # that packed this batch (the id rode the ring slot
+                # header across the process boundary)
+                with trace.span("consumer.dispatch", part=cur_part,
+                                producer_span=(pool.last_producer_span
+                                               if use_process else 0)):
+                    self._dispatch_item(job_type, item, push_cnt,
+                                        want_counts, job, dim_min,
+                                        pending, cache=cache,
+                                        part=cur_part)
+            else:
+                self._dispatch_item(job_type, item, push_cnt, want_counts,
+                                    job, dim_min, pending, cache=cache,
+                                    part=cur_part)
             if use_process:
                 lease = pool.pop_lease()
                 if lease is not None:
@@ -1838,8 +1927,8 @@ class SGDLearner(Learner):
                 pending = []
         self._final_merge(job_type, pending, prog)
         retire(keep=0)
-        if use_process:
-            self._add_stage("pack_s", pool.pack_s)
+        # process mode: the workers' parse/pack/ring-wait seconds arrived
+        # through the pool's obs snapshot channel — nothing to copy here
         self._report_part(job_type, before, prog)
         if cache is not None:
             cache.finish_pass()
@@ -1848,12 +1937,19 @@ class SGDLearner(Learner):
                          label=None) -> None:
         """Run the fused step on an already-staged packed batch. ``payload``
         = (layout, i32_dev, f32_dev, b_cap, dim2, u_cap, want_counts,
-        binary, nrows); dim2 is the panel width or the COO nnz_cap."""
+        binary, nrows); dim2 is the panel width or the COO nnz_cap.
+        Traverses the ``step.device`` chaos injection point (step.py)
+        and accounts the dispatch into stage_seconds_total{stage=step}
+        + the train_step_seconds histogram."""
+        from ..step import fire_step_fault
+        fire_step_fault()
         t0 = time.perf_counter()
         try:
             self._dispatch_packed_inner(job_type, payload, pending, label)
         finally:
-            self._add_stage("step_s", time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._stage_c["step"].inc(dt)
+            self._step_h.observe(dt)
 
     def _dispatch_packed_inner(self, job_type: int, payload, pending: list,
                                label=None) -> None:

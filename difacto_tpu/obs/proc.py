@@ -1,0 +1,45 @@
+"""Child-process metric aggregation: exact cross-process counters.
+
+Producer worker processes (data/producer_pool.py ProcessProducerPool)
+instrument their half of the pipeline against their OWN process-global
+registry — a fresh spawn starts at zero, so its registry IS this run's
+contribution. :func:`publish_blob` packages that registry snapshot plus
+any collected trace spans into one picklable blob the worker puts on its
+existing result queue (after each finished part, and on clean exit);
+:func:`absorb_blob` attaches it in the parent.
+
+Two properties make the totals exact rather than sampled:
+
+- blobs carry CUMULATIVE snapshots and the parent keeps only the NEWEST
+  per child (``Registry.set_child``) — a lost or reordered publish can
+  only make the parent's view momentarily stale, never double-counted;
+- when the pool shuts down it folds the final child snapshots into the
+  parent registry's base series (``Registry.fold_children``), so the
+  totals survive the pool object and accumulate across epochs.
+"""
+
+from __future__ import annotations
+
+from .metrics import REGISTRY, Registry
+
+# env marker the pool sets for its workers: obs runs in collect-only mode
+# (trace events ship via the queue; no atexit trace-file write)
+CHILD_ENV = "DIFACTO_OBS_CHILD"
+
+
+def publish_blob() -> dict:
+    """The worker side: this process's cumulative registry snapshot plus
+    the trace events collected since the last publish."""
+    from . import trace
+    return {"snap": REGISTRY.snapshot() if REGISTRY.enabled else {},
+            "events": trace.drain_events()}
+
+
+def absorb_blob(registry: Registry, key, blob: dict) -> None:
+    """The parent side: replace the child's attached snapshot with the
+    newer one and merge its trace events into the local sink."""
+    snap = blob.get("snap")
+    if snap:
+        registry.set_child(key, snap)
+    from . import trace
+    trace.add_events(blob.get("events") or [])

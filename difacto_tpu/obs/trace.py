@@ -1,0 +1,191 @@
+"""Nestable trace spans emitting Chrome trace-event JSON (Perfetto).
+
+The timing half of the obs subsystem (metrics.py is the counting half):
+``with span("consumer.step", part=3):`` records one complete ("X") event
+with microsecond timestamps. Events carry ``pid``/``tid``, so a file
+holding events from the parent AND its producer worker processes renders
+as one timeline in Perfetto / chrome://tracing — worker parse -> pack ->
+ring wait -> consumer unpack -> device step, side by side.
+
+Cross-process story: timestamps come from ``time.perf_counter`` (Linux
+CLOCK_MONOTONIC — one clock for every process on the machine), so worker
+events align with parent events with no offset bookkeeping. Worker
+processes inherit ``DIFACTO_TRACE`` through the environment and collect
+events in memory; the producer pool ships them to the parent through the
+existing result queues (obs/proc.py) instead of writing files — only the
+process that owns the trace writes it (child processes are marked with
+``DIFACTO_OBS_CHILD=1`` and never install the atexit save). The pack
+span's id additionally rides the shm-ring slot header
+(data/shm_ring.py), so the consumer's unpack/step spans can point at the
+exact producer span that built their batch (``producer_span`` arg).
+
+Tracing is OFF unless ``DIFACTO_TRACE=<path>`` is set (or ``start()`` is
+called); an inactive ``span`` is a single global read plus a no-op yield.
+The event buffer is bounded (default 200k events) — overflow drops new
+events and counts them, never grows without limit.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Iterator, List, Optional
+
+_MAX_EVENTS = 200_000
+
+_mu = threading.Lock()
+_events: List[dict] = []
+_dropped = 0
+_active = False
+_path: Optional[str] = None
+_trace_id = 0
+_span_ids = itertools.count(1)
+_tls = threading.local()  # per-thread span stack
+
+
+def _now_us() -> float:
+    return time.perf_counter() * 1e6
+
+
+def active() -> bool:
+    return _active
+
+
+def trace_id() -> int:
+    return _trace_id
+
+
+def set_trace_id(tid: int) -> None:
+    """Adopt a parent process's trace id (propagated through
+    pack_stream.StreamSpec into producer workers)."""
+    global _trace_id
+    _trace_id = int(tid)
+
+
+def start(path: Optional[str] = None,
+          trace_id_: Optional[int] = None) -> None:
+    """Begin collecting span events. ``path`` (optional) is where
+    :func:`save` / the atexit hook writes the Chrome trace JSON."""
+    global _active, _path, _trace_id
+    _active = True
+    if path:
+        _path = path
+    _trace_id = (trace_id_ if trace_id_ is not None
+                 else _trace_id or (os.getpid() << 16) | int(time.time()) % (1 << 16))
+
+
+def stop() -> None:
+    global _active
+    _active = False
+
+
+def current_span_id() -> int:
+    """The innermost open span's id on this thread (0 outside any)."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else 0
+
+
+def last_span_id() -> int:
+    """The most recently CLOSED span's id on this thread — how a caller
+    that consumed a span-wrapped producer (e.g. the ring writer stamping
+    the slot header with the pack span) names the span that just ran."""
+    return getattr(_tls, "last", 0)
+
+
+def add_event(ev: dict) -> None:
+    global _dropped
+    with _mu:
+        if len(_events) >= _MAX_EVENTS:
+            _dropped += 1
+            return
+        _events.append(ev)
+
+
+def add_events(evs: List[dict]) -> None:
+    """Merge events shipped from a child process (obs/proc.py)."""
+    global _dropped
+    if not evs:
+        return
+    with _mu:
+        room = _MAX_EVENTS - len(_events)
+        _events.extend(evs[:room])
+        _dropped += max(0, len(evs) - room)
+
+
+def drain_events() -> List[dict]:
+    """Take (and clear) the collected events — how worker processes hand
+    their spans to the parent through the result queue."""
+    global _events
+    with _mu:
+        out, _events = _events, []
+    return out
+
+
+@contextlib.contextmanager
+def span(name: str, **args) -> Iterator[int]:
+    """Record a complete trace event around the body. Nesting is
+    per-thread; the event carries its span id, parent span id and the
+    run's trace id, plus any keyword args (ints/strings only — they go
+    straight into the JSON)."""
+    if not _active:
+        yield 0
+        return
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    sid = next(_span_ids)
+    parent = stack[-1] if stack else 0
+    stack.append(sid)
+    t0 = _now_us()
+    try:
+        yield sid
+    finally:
+        dur = _now_us() - t0
+        stack.pop()
+        _tls.last = sid
+        ev = {"name": name, "ph": "X", "ts": t0, "dur": dur,
+              "pid": os.getpid(), "tid": threading.get_ident() & 0xFFFFFFFF,
+              "args": {"span_id": sid, "parent": parent,
+                       "trace_id": _trace_id, **args}}
+        add_event(ev)
+
+
+def save(path: Optional[str] = None) -> Optional[str]:
+    """Write the collected events as Chrome trace JSON (loadable in
+    Perfetto: ui.perfetto.dev, or chrome://tracing). Returns the path
+    written, or None when there is nowhere to write."""
+    path = path or _path
+    if not path:
+        return None
+    with _mu:
+        events = list(_events)
+        dropped = _dropped
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": {"trace_id": _trace_id, "dropped_events": dropped}}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def _maybe_start_from_env() -> None:
+    path = os.environ.get("DIFACTO_TRACE", "")
+    if not path:
+        return
+    if os.environ.get("DIFACTO_OBS_CHILD"):
+        # producer worker: collect in memory, ship via the result queue
+        # (obs/proc.py) — never write the parent's trace file
+        start()
+        return
+    start(path)
+    atexit.register(save)
+
+
+_maybe_start_from_env()

@@ -134,6 +134,9 @@ class HeartbeatMonitor:
 
     # ------------------------------------------------------------ threads
     def _send_loop(self) -> None:
+        from ..obs import counter
+        beats = counter("hb_beats_sent_total",
+                        "UDP heartbeats sent to peers")
         msg = str(self.rank).encode()
         while not self._stop.is_set():
             for r, addr in enumerate(self._addrs):
@@ -141,6 +144,7 @@ class HeartbeatMonitor:
                     continue
                 try:
                     self._sock.sendto(msg, addr)
+                    beats.inc()
                 except OSError:
                     pass
             self._stop.wait(self.interval)
@@ -165,6 +169,10 @@ class HeartbeatMonitor:
             return
         dead = self.dead_peers()
         if dead:
+            from ..obs import counter
+            counter("hb_peer_dead_total",
+                    "peers declared dead by heartbeat silence").inc(
+                        len(dead))
             code = exit_code_for(dead)
             log.error(
                 "host %d: peer(s) %s dead while blocked in a collective "
@@ -182,6 +190,10 @@ class HeartbeatMonitor:
         collective — cheaper than entering and relying on the watchdog)."""
         dead = self.dead_peers()
         if dead:
+            from ..obs import counter
+            counter("hb_peer_dead_total",
+                    "peers declared dead by heartbeat silence").inc(
+                        len(dead))
             raise HostFailure(dead)
 
     def collective(self):

@@ -70,10 +70,22 @@ def allgather_np(arr) -> "np.ndarray":
     """
     import jax
     import numpy as np
+    _fire_dcn_fault()
     if jax.process_count() == 1:
         return np.asarray(arr)[None]
     from jax.experimental import multihost_utils
     return np.asarray(multihost_utils.process_allgather(np.asarray(arr)))
+
+
+def _fire_dcn_fault() -> None:
+    """Chaos-harness injection point ``dcn.collective``: traversed before
+    every cross-host control exchange (device or KV-store flavor), BEFORE
+    the single-process early return so chaos tests exercise it without a
+    cluster. ``err`` models a dead coordinator / partitioned DCN link
+    surfacing as the same OSError a real gRPC failure raises; fires count
+    into ``faults_fired_total{point,kind}``."""
+    from ..utils import faultinject
+    faultinject.act_default(faultinject.fire("dcn.collective"))
 
 
 # --------------------------------------------------------------- control
@@ -104,6 +116,11 @@ def control_allgather_np(arr) -> "np.ndarray":
     import jax
     import numpy as np
     global _ctrl_seq
+    _fire_dcn_fault()
+    from ..obs import REGISTRY
+    REGISTRY.counter(
+        "dcn_collectives_total",
+        "cross-host control-plane exchanges issued").inc()
     a = np.ascontiguousarray(np.asarray(arr))
     if jax.process_count() == 1:
         return a[None]

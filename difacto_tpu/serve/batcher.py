@@ -38,73 +38,111 @@ log = logging.getLogger("difacto_tpu")
 
 
 class ServeStats:
-    """Thread-safe serving counters + latency window."""
+    """Serving counters + latency, REGISTRY-BACKED (difacto_tpu/obs).
+
+    The counters behind ``#stats`` now live in an obs registry — one per
+    server instance so concurrent servers in a process never blur — which
+    is also what the ``#metrics`` Prometheus endpoint renders (serve/
+    server.py). The ``snapshot()`` wire format is byte-compatible with
+    the hand-rolled counters it replaced: same keys, same meanings; the
+    exact sliding-window percentiles (p50/p95/p99 over the last
+    ``window`` responses) are kept for ``#stats``, while the registry's
+    ``serve_latency_seconds`` histogram carries the whole-run quantiles
+    Prometheus-side. This registry is always enabled — ``#stats`` is a
+    wire contract, not optional telemetry — so ``DIFACTO_OBS=off`` only
+    disables the default-registry instrumentation, never serving stats.
+    """
 
     def __init__(self, reporter: Optional[Reporter] = None,
-                 report_every_s: float = 30.0, window: int = 8192):
-        self._mu = threading.Lock()
+                 report_every_s: float = 30.0, window: int = 8192,
+                 registry=None):
+        from ..obs import Registry
+        self.obs = registry if registry is not None \
+            else Registry(enabled=True)
+        self._mu = threading.Lock()     # latency window + report throttle
         self._lat = collections.deque(maxlen=window)  # seconds
         self._t0 = time.monotonic()
         self._last_report = self._t0
         self._report_every = report_every_s
         self.reporter = reporter
-        self.n_requests = 0     # admitted requests (rows)
-        self.n_responses = 0    # scored responses
-        self.n_shed = 0
-        self.n_errors = 0
-        self.n_batches = 0
-        self.rows_batched = 0
-        self.queue_depth = 0    # sampled at each batch flush
-        self.queue_depth_max = 0
+        self._req_c = self.obs.counter(
+            "serve_requests_total", "rows admitted into the micro-batcher").labels()
+        self._resp_c = self.obs.counter(
+            "serve_responses_total", "rows scored and answered")
+        self._shed_c = self.obs.counter(
+            "serve_shed_total", "rows shed at admission (queue full or "
+            "draining)")
+        self._err_c = self.obs.counter(
+            "serve_errors_total", "rows rejected or failed")
+        self._batch_c = self.obs.counter(
+            "serve_batches_total", "micro-batches dispatched")
+        self._rows_c = self.obs.counter(
+            "serve_rows_batched_total", "rows across dispatched "
+            "micro-batches")
+        self._lat_h = self.obs.histogram(
+            "serve_latency_seconds",
+            "admit-to-answer latency per scored row")
+        self._occ_h = self.obs.histogram(
+            "serve_batch_rows", "micro-batch occupancy (rows per batch)",
+            bounds=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                    4096))
+        self._qd_g = self.obs.gauge(
+            "serve_queue_depth", "admission queue depth at the last "
+            "batch flush")
+        self._qdm_g = self.obs.gauge(
+            "serve_queue_depth_max", "high-water admission queue depth")
 
     def record_admit(self, rows: int = 1) -> None:
-        with self._mu:
-            self.n_requests += rows
+        self._req_c.inc(rows)
 
     def record_shed(self, rows: int = 1) -> None:
-        with self._mu:
-            self.n_shed += rows
+        self._shed_c.inc(rows)
 
     def record_error(self, rows: int = 1) -> None:
-        with self._mu:
-            self.n_errors += rows
+        self._err_c.inc(rows)
 
     def record_batch(self, rows: int, queue_depth: int) -> None:
-        with self._mu:
-            self.n_batches += 1
-            self.rows_batched += rows
-            self.queue_depth = queue_depth
-            self.queue_depth_max = max(self.queue_depth_max, queue_depth)
+        self._batch_c.inc()
+        self._rows_c.inc(rows)
+        self._occ_h.observe(rows)
+        self._qd_g.set(queue_depth)
+        s = self._qdm_g.labels()
+        s.set(max(s.value(), queue_depth))
 
     def record_latency(self, seconds: float) -> None:
+        self._resp_c.inc()
+        self._lat_h.observe(seconds)
         with self._mu:
-            self.n_responses += 1
             self._lat.append(seconds)
 
     def snapshot(self) -> dict:
         with self._mu:
             lat = np.asarray(self._lat, dtype=np.float64)
-            elapsed = max(time.monotonic() - self._t0, 1e-9)
-            offered = self.n_requests + self.n_shed
-            out = {
-                "requests": self.n_requests,
-                "responses": self.n_responses,
-                "shed": self.n_shed,
-                "errors": self.n_errors,
-                "shed_rate": round(self.n_shed / max(offered, 1), 4),
-                "qps": round(self.n_responses / elapsed, 1),
-                "batches": self.n_batches,
-                "batch_occupancy": round(
-                    self.rows_batched / max(self.n_batches, 1), 2),
-                "queue_depth": self.queue_depth,
-                "queue_depth_max": self.queue_depth_max,
-            }
-            if len(lat):
-                p50, p95, p99 = np.percentile(lat, [50, 95, 99]) * 1e3
-                out.update(p50_ms=round(float(p50), 3),
-                           p95_ms=round(float(p95), 3),
-                           p99_ms=round(float(p99), 3),
-                           max_ms=round(float(lat.max() * 1e3), 3))
+        n_requests = int(self._req_c.value())
+        n_responses = int(self._resp_c.value())
+        n_shed = int(self._shed_c.value())
+        n_batches = int(self._batch_c.value())
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        offered = n_requests + n_shed
+        out = {
+            "requests": n_requests,
+            "responses": n_responses,
+            "shed": n_shed,
+            "errors": int(self._err_c.value()),
+            "shed_rate": round(n_shed / max(offered, 1), 4),
+            "qps": round(n_responses / elapsed, 1),
+            "batches": n_batches,
+            "batch_occupancy": round(
+                self._rows_c.value() / max(n_batches, 1), 2),
+            "queue_depth": int(self._qd_g.value()),
+            "queue_depth_max": int(self._qdm_g.value()),
+        }
+        if len(lat):
+            p50, p95, p99 = np.percentile(lat, [50, 95, 99]) * 1e3
+            out.update(p50_ms=round(float(p50), 3),
+                       p95_ms=round(float(p95), 3),
+                       p99_ms=round(float(p99), 3),
+                       max_ms=round(float(lat.max() * 1e3), 3))
         return out
 
     def maybe_report(self) -> None:

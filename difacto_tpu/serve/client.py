@@ -184,6 +184,35 @@ class ServeClient:
         polls to rotate a draining replica out before it exits."""
         return json.loads(self.score_lines([b"#health"])[0])
 
+    def metrics(self) -> str:
+        """Prometheus-format metric text (#metrics): the one multi-line
+        control reply — the server terminates it with a single blank
+        line (the exposition format never emits blank lines itself), so
+        this reads until that sentinel instead of one line per request."""
+        deadline = self._deadline()
+        attempt = 0
+        while True:
+            try:
+                self._ensure_conn(deadline)
+                self._sock.sendall(b"#metrics\n")
+                lines = []
+                while True:
+                    resp = self._rfile.readline()
+                    if not resp:
+                        raise ConnectionError(
+                            "server closed the connection")
+                    if resp == b"\n":
+                        return b"".join(lines).decode()
+                    if not lines and resp.startswith(b"!err"):
+                        raise RuntimeError(resp.rstrip(b"\n").decode())
+                    lines.append(resp)
+            except (OSError, ConnectionError):
+                self._drop_conn()
+                if attempt >= self.retries:
+                    raise
+                self._backoff(attempt, deadline)
+                attempt += 1
+
     def reload(self, path: Optional[str] = None) -> dict:
         """Trigger a synchronous model hot-reload (#reload [path]);
         returns the server's {'ok', 'model_generation'|'error'} verdict."""

@@ -76,14 +76,16 @@ def open_serving_store(model_in: str, kwargs: KWArgs = (),
     ``kwargs`` so the gather-side semantics can be overridden when
     needed. Returns (store, meta, leftover kwargs).
 
-    Every candidate is manifest-verified before loading
-    (utils/manifest.py). When the resolved file is corrupt/torn and
-    ``fallback`` is on (serve startup), the loader walks the checkpoint
-    family back to the newest generation that verifies — a torn final
-    save must not take a replica down when a good interval checkpoint
-    sits next to it. ``fallback=False`` (hot reload) raises instead: a
-    failed reload keeps the CURRENT in-memory model, never silently
-    regresses to an older file."""
+    Every candidate is manifest-verified IN the load itself — the store
+    hashes npz members as they stream in (store/local.py load over
+    utils/manifest.VerifiedNpz), so a serving load costs one IO pass
+    instead of the old verify-then-load double read. When the resolved
+    file is corrupt/torn and ``fallback`` is on (serve startup), the
+    loader walks the checkpoint family back to the newest generation
+    that verifies — a torn final save must not take a replica down when
+    a good interval checkpoint sits next to it. ``fallback=False`` (hot
+    reload) raises instead: a failed reload keeps the CURRENT in-memory
+    model, never silently regresses to an older file."""
     from ..utils import manifest as mft
     from ..utils.manifest import CheckpointCorrupt
 
@@ -94,7 +96,7 @@ def open_serving_store(model_in: str, kwargs: KWArgs = (),
     last_err: Optional[CheckpointCorrupt] = None
     for cand in candidates:
         try:
-            mft.verify(cand)
+            out = _open_verified(cand, kwargs)
         except FileNotFoundError:
             continue
         except CheckpointCorrupt as e:
@@ -104,8 +106,9 @@ def open_serving_store(model_in: str, kwargs: KWArgs = (),
         if cand != path:
             log.warning("model %s is corrupt; serving previous verified "
                         "generation %s instead", path, cand)
-        return _open_verified(cand, kwargs)
-    assert last_err is not None
+        return out
+    if last_err is None:
+        raise FileNotFoundError(path)
     raise last_err
 
 
@@ -125,8 +128,9 @@ def _open_verified(path: str, kwargs: KWArgs
     uparam = dataclasses.replace(uparam, V_dim=meta["V_dim"],
                                  hash_capacity=meta["hash_capacity"])
     store = SlotStore(uparam, read_only=True)
-    # verify=False: the caller just manifest-verified this exact file
-    n = store.load(meta["path"], verify=False)
+    # single-pass verified load: members hash while they stream in
+    # (manifest.VerifiedNpz) — no separate verify read
+    n = store.load(meta["path"])
     log.info("serving store: %s (%s, V_dim=%d, %d non-empty entries, "
              "weights-only)", meta["path"],
              "hashed" if meta["hashed"] else "dictionary", meta["V_dim"], n)

@@ -118,10 +118,16 @@ class ModelReloader:
                 gen = self.executor.swap_store(store)
             except Exception as e:
                 self.reload_failures += 1
+                from ..obs import counter
+                counter("model_reload_failures_total",
+                        "failed hot-reloads (old model kept)").inc()
                 log.warning("model reload from %s failed; keeping the "
                             "current model: %s", target, e)
                 return {"ok": False, "error": str(e)}
             self.reloads += 1
+            from ..obs import counter
+            counter("model_reloads_total",
+                    "successful model hot-reloads").inc()
             if fp is not None:
                 self._cur = fp
             log.info("model reloaded from %s: generation %d",

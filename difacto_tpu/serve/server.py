@@ -8,6 +8,10 @@ Wire protocol (newline-delimited, UTF-8/ASCII):
 - response line = ``%g``-formatted probability (``pred_prob=False``: the
   raw clamped margin) for that row, in request order per connection;
 - ``#stats`` -> one JSON line of serving + executor counters;
+- ``#metrics`` -> Prometheus text exposition of the server's obs
+  registry (difacto_tpu/obs): latency histogram + derived p50/p95/p99
+  quantiles, queue depth, shed/error counters, model_generation — ends
+  with a blank line so line-oriented clients know where it stops;
 - ``!shed`` -> the admission queue was full (overload backpressure —
   resend later or slow down);
 - ``!err <reason>`` -> the row was rejected (malformed, oversized).
@@ -53,6 +57,11 @@ class ServeServer:
             reporter.set_monitor(
                 lambda _node, payload: log.info("serve: %s", payload))
         self.stats = ServeStats(reporter, report_every_s=report_every_s)
+        # the server's obs registry (ServeStats owns it): #metrics
+        # renders it merged with the process-global registry (faults,
+        # pipeline counters) — per-server series never blur across
+        # servers in one process
+        self.obs = self.stats.obs
         self.batcher = MicroBatcher(self.executor.predict_scores,
                                     batch_size=batch_size,
                                     max_delay_ms=max_delay_ms,
@@ -269,9 +278,45 @@ class ServeServer:
             with self._mu:
                 self._conns.discard(conn)
 
+    def metrics_text(self) -> str:
+        """Prometheus text for the ``#metrics`` control line: the
+        server's registry (latency histogram + quantiles, queue/shed
+        counters) with the executor/reloader state mirrored into gauges
+        at render time, merged with the process-global registry (fault
+        fires, pipeline counters)."""
+        from ..obs import REGISTRY, merge_into, render_prometheus
+        ex = self.executor.stats()
+        self.obs.gauge("serve_model_generation",
+                       "generation of the model currently serving"
+                       ).set(ex["model_generation"])
+        self.obs.gauge("serve_buckets_compiled",
+                       "predict shape buckets compiled so far"
+                       ).set(ex["buckets_compiled"])
+        self.obs.gauge("serve_dispatches",
+                       "predict executor dispatches").set(ex["dispatches"])
+        self.obs.gauge("serve_queue_cap", "admission bound in rows"
+                       ).set(self.batcher.queue_cap)
+        self.obs.gauge("serve_draining",
+                       "1 while draining for shutdown"
+                       ).set(1.0 if self.draining else 0.0)
+        if self.reloader is not None:
+            rs = self.reloader.stats()
+            self.obs.gauge("serve_reloads",
+                           "successful model hot-reloads"
+                           ).set(rs["reloads"])
+            self.obs.gauge("serve_reload_failures",
+                           "failed model hot-reloads (old model kept)"
+                           ).set(rs["reload_failures"])
+        snap = merge_into(self.obs.snapshot(), REGISTRY.snapshot())
+        return render_prometheus(snap)
+
     def _control(self, line: bytes) -> bytes:
         if line == b"#stats":
             return (json.dumps(self.stats_snapshot()) + "\n").encode()
+        if line == b"#metrics":
+            # multi-line payload, terminated by one blank line (the text
+            # format never emits blank lines itself)
+            return self.metrics_text().encode() + b"\n"
         if line == b"#health":
             return (json.dumps(self.health_snapshot()) + "\n").encode()
         if line == b"#reload" or line.startswith(b"#reload "):

@@ -73,6 +73,18 @@ def make_step_fns(fns, loss: LossSpec, train_auc: str = "binned") -> Tuple:
     return forward, train_step, eval_step
 
 
+def fire_step_fault() -> None:
+    """Chaos-harness injection point ``step.device`` (utils/faultinject):
+    traversed on the HOST once per dispatched device step (the jitted
+    programs themselves are pure and cannot host an injection site).
+    ``err`` models a poisoned program / lost device surfacing at dispatch
+    — it raises the same OSError-derived FaultInjected the IO paths use,
+    so the learner's failure handling is exercised end to end; every
+    armed fire also counts into ``faults_fired_total{point,kind}``."""
+    from .utils import faultinject
+    faultinject.act_default(faultinject.fire("step.device"))
+
+
 def make_predict_fn(fns, loss: LossSpec):
     """Predict-only forward over (state, batch, slots) -> (pred, objv, auc).
 

@@ -451,15 +451,16 @@ class SlotStore:
         are stride-0 zero views, so a serving process pays no host RAM
         for state it will never update.
 
-        ``verify`` (default on) checks the manifest sidecar first and
-        raises a typed :class:`CheckpointCorrupt` on truncation / digest
-        mismatch instead of crashing in numpy; callers that already
-        verified (serve walk-back) pass verify=False to skip the second
-        read. ``require_manifest`` additionally treats a missing sidecar
-        as corruption — the contract for files this codebase wrote
-        (auto_resume candidates always have one)."""
-        if verify:
-            mft.verify(path, require_manifest=require_manifest)
+        ``verify`` (default on) raises a typed
+        :class:`CheckpointCorrupt` on truncation / digest mismatch
+        instead of crashing in numpy — in ONE IO pass: members hash as
+        they decompress for the load and the few the load skips are
+        swept before any state commits (utils/manifest.py VerifiedNpz —
+        the old separate verify pass read every byte twice).
+        ``verify=False`` skips digesting for callers that already
+        verified the exact file. ``require_manifest`` additionally
+        treats a missing sidecar as corruption — the contract for files
+        this codebase wrote (auto_resume candidates always have one)."""
         if weights_only is None:
             weights_only = self.read_only
         loaded = (("w", "cnt", "v_live", "V") if weights_only
@@ -469,7 +470,14 @@ class SlotStore:
             # stride-0 zeros: a weights-only load allocates no aux memory
             return np.broadcast_to(np.float32(0.0), shape)
 
-        with stream.load_npz(path, fault_point="ckpt.read") as z:
+        ctx = (mft.open_verified(path, require_manifest=require_manifest,
+                                 fault_point="ckpt.read") if verify
+               else stream.load_npz(path, fault_point="ckpt.read"))
+        # digest sweep of manifest members the load never touched; runs
+        # BEFORE state commits so a corrupt file can't leave a half-
+        # loaded store behind (plain npz ctx: nothing to sweep)
+        fin = getattr(ctx, "finish", lambda: None)
+        with ctx as z:
             if self.hashed != ("hash_capacity" in z.files):
                 raise ValueError(
                     "checkpoint store mode mismatch: "
@@ -504,6 +512,7 @@ class SlotStore:
                     if k in z.files:
                         arr[k] = z[k]
                 nnz = int((np.asarray(arr["w"]) != 0).sum())
+                fin()
                 self.state = self._place(self._assemble_state(
                     arr, self.param.hash_capacity))
                 return nnz
@@ -512,11 +521,8 @@ class SlotStore:
                 raise ValueError(
                     f"checkpoint V_dim={ck_vdim} != configured "
                     f"V_dim={self.param.V_dim} ({path})")
-            keys = z["keys"]
+            keys = np.asarray(z["keys"], dtype=FEAID_DTYPE)  # saved sorted
             n = len(keys)
-            self._keys = keys.astype(FEAID_DTYPE)  # saved sorted
-            self._slots = np.arange(1, n + 1, dtype=np.int64)
-            self._next_slot = n + 1
             cap = self.state.capacity
             while cap < n + 1:
                 cap *= 2
@@ -540,7 +546,13 @@ class SlotStore:
                 arr["sqrt_g"][sl] = z["sqrt_g"]
                 if z["Vg"].size:
                     arr["Vg"][sl] = z["Vg"]
+            fin()
+            # commit only after the digest sweep: the host dictionary and
+            # device state move together or not at all
             self.state = self._place(self._assemble_state(arr, cap))
+            self._keys = keys
+            self._slots = np.arange(1, n + 1, dtype=np.int64)
+            self._next_slot = n + 1
         return n
 
     def dump(self, path: str, dump_aux: bool = False,

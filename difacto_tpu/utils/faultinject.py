@@ -11,7 +11,11 @@ run of a real cluster) arm through one environment variable:
 - ``point`` — a dotted site name. Current points: ``ckpt.write``,
   ``ckpt.read`` (utils/stream.py), ``serve.sock.read``,
   ``serve.sock.write`` (serve/server.py), ``batcher.enqueue``
-  (serve/batcher.py), ``producer.part`` (data/producer_pool.py).
+  (serve/batcher.py), ``producer.part`` (data/producer_pool.py),
+  ``step.device`` (the host-side dispatch of a fused device step,
+  step.py fire_step_fault — a poisoned program / device loss stand-in),
+  ``dcn.collective`` (the cross-host control-plane exchange,
+  parallel/multihost.py — a dead-coordinator / partition stand-in).
 - ``kind`` — what happens when the fault fires:
     - ``err``      raise :class:`FaultInjected` (an OSError, so IO call
                    sites treat it exactly like a real IO failure);
@@ -140,6 +144,14 @@ def fire(point: str) -> Optional[str]:
                 continue
             f.fired += 1
             f.hits = 0  # re-arm: after_n skips apply to the next cycle too
+        # every armed fire is observable: chaos runs watch
+        # faults_fired_total{point,kind} alongside the failure it causes
+        # (import deferred — this branch only runs when a fault fires)
+        from ..obs import REGISTRY
+        REGISTRY.counter(
+            "faults_fired_total",
+            "injected faults that actually fired, per point and kind"
+        ).labels(point=point, kind=f.kind).inc()
         if f.kind == "delay_ms":
             time.sleep(f.arg / 1e3)
             continue

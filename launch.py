@@ -72,6 +72,17 @@ import time
 RELAUNCH_BACKOFF_CAP_S = 60.0
 
 
+def _obs_inc(name: str, help_: str = "") -> None:
+    """Count a supervision event into the obs registry (difacto_tpu/obs)
+    when the repo is importable — the launcher also runs standalone on
+    bare cluster hosts, where this is a silent no-op."""
+    try:
+        from difacto_tpu.obs import counter
+    except ImportError:  # pragma: no cover - launched outside the repo
+        return
+    counter(name, help_).inc()
+
+
 def _relaunch_delay(attempt: int, hb_timeout: float,
                     rng: random.Random = random) -> float:
     """Seconds to wait before relaunch ``attempt`` + 1: exponential in
@@ -532,14 +543,20 @@ def main() -> int:
             else:
                 victim = len(cur_hosts) - 1
             evicted = cur_hosts.pop(victim)
+            _obs_inc("launch_evictions_total",
+                     "hosts evicted after a detected death")
             # exponential backoff + jitter between relaunches (floored
             # at one heartbeat timeout so ssh orphans self-abort first)
             time.sleep(_relaunch_delay(attempt, args.hb_timeout))
             print(f"[launch] attempt {attempt} failed (rc={rc}); evicting "
                   f"{evicted}, relaunching on {cur_hosts}", file=sys.stderr)
         else:
+            _obs_inc("launch_evictions_total",
+                     "hosts evicted after a detected death")
             print(f"[launch] attempt {attempt} failed (rc={rc}); evicting "
                   f"one host, relaunching {n} process(es)", file=sys.stderr)
+        _obs_inc("launch_relaunches_total",
+                 "survivor relaunch attempts after an eviction")
     return rc
 
 

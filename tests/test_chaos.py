@@ -476,3 +476,147 @@ def test_producer_part_fault_is_retried(rcv1_path, tmp_path):
         "fault never fired — the test proved nothing"
     store, _, _ = open_serving_store(model)
     assert store.num_features > 0
+
+
+# ------------------------------------- new fault points (ISSUE 4 satellite)
+
+def test_step_device_fault_fires_typed(rcv1_path, tmp_path):
+    """``step.device`` (step.py fire_step_fault): an injected error at
+    the host-side step dispatch surfaces as the typed FaultInjected
+    (OSError) out of the learner — and BOTH observability surfaces saw
+    it fire: faultinject.stats() and faults_fired_total{point,kind}."""
+    from difacto_tpu.learners import Learner
+    from difacto_tpu.obs import REGISTRY
+    from difacto_tpu.utils.faultinject import FaultInjected
+
+    before = REGISTRY.value("faults_fired_total", point="step.device",
+                            kind="err")
+    faultinject.configure("step.device:err@1")
+    ln = Learner.create("sgd")
+    ln.init([("data_in", rcv1_path), ("V_dim", "0"), ("l2", "1"),
+             ("l1", "0"), ("lr", "1"), ("num_jobs_per_epoch", "1"),
+             ("batch_size", "100"), ("max_num_epochs", "1"),
+             ("shuffle", "0"), ("report_interval", "0"),
+             ("device_cache_mb", "0"), ("hash_capacity", "1024"),
+             ("producer_mode", "thread")])
+    with deadline(120):
+        with pytest.raises(FaultInjected):
+            ln.run()
+    assert faultinject.stats().get("step.device", 0) > 0, \
+        "fault never fired — the test proved nothing"
+    assert REGISTRY.value("faults_fired_total", point="step.device",
+                          kind="err") > before
+
+
+def test_dcn_collective_fault_fires_typed():
+    """``dcn.collective`` (parallel/multihost.py): an injected error at
+    the cross-host control exchange raises typed BEFORE the single-
+    process fast path, so the chaos harness needs no cluster — and the
+    fire lands in faults_fired_total."""
+    from difacto_tpu.obs import REGISTRY
+    from difacto_tpu.parallel.multihost import control_allgather_np
+    from difacto_tpu.utils.faultinject import FaultInjected
+
+    # unarmed: the exchange works and counts
+    faultinject.configure("")
+    dcn_before = REGISTRY.value("dcn_collectives_total")
+    out = control_allgather_np(np.arange(4, dtype=np.int32))
+    assert out.shape == (1, 4)
+    assert REGISTRY.value("dcn_collectives_total") == dcn_before + 1
+
+    before = REGISTRY.value("faults_fired_total", point="dcn.collective",
+                            kind="err")
+    faultinject.configure("dcn.collective:err@1")
+    with pytest.raises(FaultInjected):
+        control_allgather_np(np.arange(4, dtype=np.int32))
+    assert faultinject.stats().get("dcn.collective", 0) > 0
+    assert REGISTRY.value("faults_fired_total", point="dcn.collective",
+                          kind="err") > before
+
+
+# --------------------------- single-pass verified loads (ISSUE 4 satellite)
+
+def test_single_pass_verified_load(ckpt_model, monkeypatch):
+    """Satellite: a verified load opens/reads the npz ONCE (the old
+    flow's separate verify pass read every byte twice), yields byte-
+    identical state to an unverified load, and still raises the typed
+    CheckpointCorrupt on a bit flip — before any state commits."""
+    from difacto_tpu.store.local import SlotStore
+    from difacto_tpu.updaters.sgd_updater import SGDUpdaterParam
+    from difacto_tpu.utils import manifest as mft
+    from difacto_tpu.utils import stream
+
+    path = f"{ckpt_model}_part-0"
+    opens = []
+    real = stream.load_npz
+
+    def counting(uri, fault_point=""):
+        opens.append(uri)
+        return real(uri, fault_point=fault_point)
+
+    monkeypatch.setattr(stream, "load_npz", counting)
+
+    st_v = SlotStore(SGDUpdaterParam(V_dim=0))
+    st_v.load(path, require_manifest=True)   # verified, single pass
+    assert opens == [path], opens
+
+    opens.clear()
+    st_raw = SlotStore(SGDUpdaterParam(V_dim=0))
+    st_raw.load(path, verify=False)
+    assert opens == [path]
+
+    # byte-identical results: the hash-while-loading path changes no data
+    a = st_v._state_np(st_v.state)
+    b = st_raw._state_np(st_raw.state)
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+    np.testing.assert_array_equal(st_v._keys, st_raw._keys)
+
+    # corruption still surfaces typed, with no partial state left behind
+    import shutil
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        bad = os.path.join(d, "bad")
+        shutil.copy(path, bad)
+        shutil.copy(path + mft.MANIFEST_SUFFIX,
+                    bad + mft.MANIFEST_SUFFIX)
+        corrupt_flip(bad)
+        st_c = SlotStore(SGDUpdaterParam(V_dim=0))
+        cap0 = st_c.state.capacity
+        with pytest.raises(mft.CheckpointCorrupt) as ei:
+            st_c.load(bad)
+        assert bad in str(ei.value)
+        assert st_c.num_features == 0 and st_c.state.capacity == cap0
+
+
+# ------------------------------- family-wide pruning (ISSUE 4 satellite)
+
+def test_ckpt_keep_prunes_whole_family(ckpt_model, rcv1_path, tmp_path):
+    """Satellite: rank 0 prunes the WHOLE generation family — including
+    another rank's ``_part-1`` files (previously each rank pruned only
+    what it wrote, so an evicted rank's stale parts lingered forever)."""
+    import shutil
+
+    from difacto_tpu.utils import manifest as mft
+
+    model = str(tmp_path / "model")
+    # simulate an evicted rank 1: its epoch-0 and epoch-2 parts are on
+    # disk, but the rank is gone and will never prune them itself
+    for e in (0, 2):
+        shutil.copy(f"{ckpt_model}_iter-{e}_part-0",
+                    f"{model}_iter-{e}_part-1")
+        shutil.copy(f"{ckpt_model}_iter-{e}_part-0{mft.MANIFEST_SUFFIX}",
+                    f"{model}_iter-{e}_part-1{mft.MANIFEST_SUFFIX}")
+    with deadline(180):
+        assert main(train_args(rcv1_path, model,
+                               extra=("ckpt_interval=1",
+                                      "ckpt_keep=2"))) == 0
+    # 3 epochs ran; keep=2 retires epoch 0 across ALL ranks
+    assert not os.path.exists(f"{model}_iter-0_part-0")
+    assert not os.path.exists(f"{model}_iter-0_part-1")
+    assert not os.path.exists(
+        f"{model}_iter-0_part-1{mft.MANIFEST_SUFFIX}")
+    # newer generations keep every rank's parts
+    assert os.path.exists(f"{model}_iter-2_part-0")
+    assert os.path.exists(f"{model}_iter-2_part-1")

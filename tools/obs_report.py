@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Render a human summary from obs artifacts (ISSUE 4 tooling).
+
+Inputs are what the observability subsystem writes during a run:
+
+- a metrics JSONL event log (``metrics_path`` training knob, or any
+  file of ``{"ts", "metrics"}`` lines from obs/export.MetricsFlusher) —
+  the LAST line is the run's final cumulative snapshot;
+- optionally a Chrome trace JSON (``DIFACTO_TRACE=<path>``).
+
+Output: the streamed-stage table (where the run's seconds went), every
+histogram's count/mean/p50/p95/p99, top counters, and the top span
+names by total duration — the first thing to read when a streamed rate
+regresses or a serve replica's latency moves.
+
+    python tools/obs_report.py --metrics run.metrics.jsonl \
+        --trace run.trace.json
+    make obs-report METRICS=run.metrics.jsonl TRACE=run.trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+STAGE_ORDER = ("parse", "pack", "ring_wait", "transfer", "step")
+
+
+def load_last_snapshot(path: str) -> dict:
+    """Last parseable line of the JSONL log (a torn final line — crash
+    mid-flush — is skipped, the previous flush wins)."""
+    last = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                last = json.loads(line)
+            except ValueError:
+                continue
+    if last is None:
+        raise SystemExit(f"no parseable JSONL lines in {path}")
+    return last.get("metrics", last)
+
+
+def fmt_seconds(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:8.2f}s"
+    return f"{v * 1e3:7.2f}ms"
+
+
+def report_stages(snap: dict) -> None:
+    series = snap.get("counters", {}).get("stage_seconds_total", {})
+    if not series:
+        return
+    vals = {}
+    for key, v in series.items():
+        # flattened label key: "stage=pack" (export.jsonable_snapshot)
+        stage = dict(p.split("=", 1) for p in key.split(",")
+                     if "=" in p).get("stage", key)
+        vals[stage] = vals.get(stage, 0.0) + v
+    total = sum(vals.values()) or 1.0
+    print("== streamed stage table (seconds, % of accounted time) ==")
+    for stage in STAGE_ORDER + tuple(sorted(set(vals) - set(STAGE_ORDER))):
+        if stage in vals:
+            v = vals[stage]
+            print(f"  {stage:10s} {v:10.3f}s  {100 * v / total:5.1f}%")
+    print()
+
+
+def _quantiles(d: dict, qs=(0.5, 0.95, 0.99)) -> dict:
+    from difacto_tpu.obs import hist_quantiles
+    return hist_quantiles(d, qs)
+
+
+def report_hists(snap: dict) -> None:
+    hists = snap.get("hists", {})
+    if not hists:
+        return
+    print("== histograms (count / mean / p50 / p95 / p99) ==")
+    for name in sorted(hists):
+        for key, d in sorted(hists[name].items()):
+            label = f"{name}{{{key}}}" if key else name
+            n = d.get("count", 0)
+            if not n:
+                continue
+            q = _quantiles(d)
+            mean = d.get("sum", 0.0) / n
+            print(f"  {label:44s} n={n:<9d} mean={fmt_seconds(mean)} "
+                  f"p50={fmt_seconds(q[0.5])} p95={fmt_seconds(q[0.95])} "
+                  f"p99={fmt_seconds(q[0.99])}")
+    print()
+
+
+def report_counters(snap: dict, top: int = 20) -> None:
+    rows = []
+    for name, series in snap.get("counters", {}).items():
+        if name == "stage_seconds_total":
+            continue  # already in the stage table
+        for key, v in series.items():
+            rows.append((v, f"{name}{{{key}}}" if key else name))
+    if not rows:
+        return
+    print(f"== top counters ==")
+    for v, label in sorted(rows, reverse=True)[:top]:
+        print(f"  {label:54s} {v:g}")
+    print()
+
+
+def report_trace(path: str, top: int = 15) -> None:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    total = defaultdict(float)
+    count = defaultdict(int)
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        total[ev["name"]] += ev.get("dur", 0.0)
+        count[ev["name"]] += 1
+    if not total:
+        return
+    print(f"== top spans by total duration ({len(events)} events; "
+          "open the file in ui.perfetto.dev for the timeline) ==")
+    for name, us in sorted(total.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"  {name:34s} {us / 1e6:10.3f}s  x{count[name]:<8d} "
+              f"avg {fmt_seconds(us / count[name] / 1e6)}")
+    print()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--metrics", default="",
+                    help="metrics JSONL event log (metrics_path knob)")
+    ap.add_argument("--trace", default="",
+                    help="Chrome trace JSON (DIFACTO_TRACE)")
+    ap.add_argument("--top", type=int, default=20,
+                    help="rows per top-N section")
+    args = ap.parse_args()
+    if not args.metrics and not args.trace:
+        ap.error("pass --metrics and/or --trace")
+    if args.metrics:
+        snap = load_last_snapshot(args.metrics)
+        report_stages(snap)
+        report_hists(snap)
+        report_counters(snap, args.top)
+    if args.trace:
+        report_trace(args.trace, args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
