@@ -11,10 +11,15 @@ PY ?= python
 METRICS ?= run.metrics.jsonl
 TRACE ?=
 
-.PHONY: test smoke ci obs-report
+.PHONY: test smoke ci chaos obs-report
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# resilience suite alone (fault injection, drain, blue/green, takeover,
+# client failover — tests/test_chaos.py and friends)
+chaos:
+	$(PY) -m pytest tests/ -m chaos -q
 
 smoke:
 	$(PY) bench.py --device-only --steps 2 --batch-size 128 --uniq 256 --capacity 1024 --vdim 4

@@ -324,11 +324,16 @@ def run_serve_bench(args) -> dict:
     if args.serve_vdim:
         store.state = set_all_live(param, store.state)
     rows = _gen_serve_rows(512, args.nnz_per_row, 1 << 17)
+    # takeover=True (SO_REUSEPORT): the takeover-gap measurement below
+    # binds a successor to the same port, and the kernel requires every
+    # binder of the pair to set the option
     server = ServeServer(store, batch_size=args.serve_batch,
                          max_delay_ms=args.serve_delay_ms,
-                         queue_cap=args.serve_queue_cap)
+                         queue_cap=args.serve_queue_cap, takeover=True)
     server.start()
     drain_s = 0.0
+    bluegreen_ms = 0.0
+    takeover_gap_ms = 0.0
     reload_ms: list = []
     try:
         # warmup at the TARGET rate: micro-batch occupancy (and so the
@@ -348,7 +353,8 @@ def run_serve_bench(args) -> dict:
         with tempfile.TemporaryDirectory() as td:
             model = os.path.join(td, "model")
             store.save(model)
-            server.reloader = ModelReloader(server.executor, model)
+            server.reloader = ModelReloader(server.executor, model,
+                                            server=server)
             with ServeClient(server.host, server.port) as c:
                 for _ in range(5):
                     store.save(model)  # bump the generation
@@ -357,15 +363,65 @@ def run_serve_bench(args) -> dict:
                     dt = (_time.monotonic() - t0) * 1e3
                     if res.get("ok"):
                         reload_ms.append(dt)
-        # ... and graceful-drain time with the queue already empty (the
-        # floor an orchestrator pays per rotation)
+                # blue/green cost (ISSUE 5): a GEOMETRY-CHANGING reload
+                # (different V_dim) warms a second executor on the live
+                # warm-set and swaps it under the batcher — time the
+                # whole build+warm+swap the old design answered with
+                # "restart the server"
+                param2 = SGDUpdaterParam(
+                    V_dim=args.serve_vdim + 4, l1_shrk=False,
+                    hash_capacity=args.serve_capacity)
+                store2 = SlotStore(param2, read_only=True)
+                store2.state = set_all_live(param2, store2.state)
+                model2 = os.path.join(td, "model2")
+                store2.save(model2)
+                t0 = _time.monotonic()
+                res = c.reload(model2)
+                if res.get("ok"):
+                    bluegreen_ms = (_time.monotonic() - t0) * 1e3
+        # SO_REUSEPORT takeover gap: bind a successor to the SAME port,
+        # drain the incumbent, and measure handoff-start -> first fresh
+        # connection answered ready by the successor (the client-visible
+        # upper bound; the successor accepts throughout, so ~drain time)
+        import threading as _threading
+        succ = ServeServer(store2, batch_size=args.serve_batch,
+                           max_delay_ms=args.serve_delay_ms,
+                           host=server.host, port=server.port,
+                           takeover=True).start()
+        succ_id = succ.health_snapshot()["server_id"]
+        gap_box: dict = {}
+        t0 = _time.monotonic()
+
+        def _probe():
+            while _time.monotonic() - t0 < 15.0:
+                try:
+                    with ServeClient(server.host, server.port,
+                                     timeout=2.0) as pc:
+                        h = pc.health()
+                    if h.get("server_id") == succ_id \
+                            and h.get("status") == "ready":
+                        gap_box["ms"] = (_time.monotonic() - t0) * 1e3
+                        return
+                except (OSError, ConnectionError, ValueError):
+                    pass
+                _time.sleep(0.005)
+
+        probe = _threading.Thread(target=_probe)
+        probe.start()
+        # graceful-drain time with the queue already empty (the floor an
+        # orchestrator pays per rotation) doubles as the handoff
         drain_s = server.drain()
+        probe.join()
+        takeover_gap_ms = gap_box.get("ms", 0.0)
+        succ.close()
     finally:
         server.close()
     return {
         "reload_p99_ms": round(float(np.percentile(reload_ms, 99)), 3)
         if reload_ms else 0.0,
         "drain_s": round(drain_s, 3),
+        "bluegreen_swap_ms": round(bluegreen_ms, 3),
+        "takeover_gap_ms": round(takeover_gap_ms, 3),
         "p50_ms": rep.get("p50_ms", 0.0),
         "p95_ms": rep.get("p95_ms", 0.0),
         "p99_ms": rep.get("p99_ms", 0.0),

@@ -125,6 +125,29 @@ class Param:
 _FIELD_TYPES = {"int": int, "float": float, "str": str, "bool": bool}
 
 
+def parse_endpoints(spec) -> List[Tuple[str, int]]:
+    """``"h1:p1,h2:p2"`` (or a list of ``"h:p"`` strings / ``(h, p)``
+    pairs) -> ``[(host, port), ...]``. The one endpoint-list grammar
+    shared by the failover client (serve/client.py), tools/loadgen.py
+    ``--endpoints`` and tools/takeover.py — a replica list is config, so
+    its parser lives with the config layer."""
+    parts = ([p for p in spec.split(",") if p.strip()]
+             if isinstance(spec, str) else list(spec))
+    out: List[Tuple[str, int]] = []
+    for p in parts:
+        if isinstance(p, (tuple, list)):
+            host, port = p
+        else:
+            host, _, port = str(p).strip().rpartition(":")
+            if not host:
+                raise ValueError(
+                    f"bad endpoint {p!r} (want host:port)")
+        out.append((host, int(port)))
+    if not out:
+        raise ValueError(f"empty endpoint list: {spec!r}")
+    return out
+
+
 def warn_unknown(remain: KWArgs) -> None:
     """Log unconsumed keys at the end of the config chain (src/main.cc:40-46)."""
     for k, v in remain:

@@ -304,6 +304,10 @@ class SGDLearnerParam(Param):
     # the log; DIFACTO_TRACE=<path> additionally captures span timelines.
     metrics_path: str = ""
     metrics_interval_s: float = 30.0
+    # roll metrics_path to <path>.1 when it would exceed this many MB
+    # (0 = unbounded) — long-running processes cap their event log
+    metrics_max_mb: float = dataclasses.field(default=0.0,
+                                              metadata=dict(lo=0))
 
 
 @register("sgd")
@@ -569,7 +573,8 @@ class SGDLearner(Learner):
             from ..obs import REGISTRY, MetricsFlusher
             self._flusher = MetricsFlusher(
                 p.metrics_path, p.metrics_interval_s,
-                registries=[self.obs, REGISTRY]).start()
+                registries=[self.obs, REGISTRY],
+                max_mb=p.metrics_max_mb).start()
         self._report = ReportProg()
         # live nnz(w)/penalty flow through the Reporter contract
         # (include/difacto/reporter.h:14-56): the part cadence reports a

@@ -112,16 +112,24 @@ class MetricsFlusher:
     ``{"ts": <epoch seconds>, "metrics": <jsonable snapshot>}`` —
     append-only, crash-tolerant (a torn last line is skipped by readers),
     and diffable across flushes. ``trace_path`` additionally saves the
-    collected span events as Chrome trace JSON on close."""
+    collected span events as Chrome trace JSON on close.
+
+    ``max_mb`` > 0 caps the file: when the next flush would push it past
+    the cap, the current file rolls to ``<path>.1`` (replacing any
+    previous roll) and a fresh file starts — a weeks-long serve process
+    holds at most ~2x ``max_mb`` of metrics log instead of growing
+    without bound. Readers (tools/obs_report.py) look at the rolled file
+    too, so history survives one rotation."""
 
     def __init__(self, path: str, interval_s: float = 30.0,
                  registries: Optional[Sequence[Registry]] = None,
-                 trace_path: str = "") -> None:
+                 trace_path: str = "", max_mb: float = 0.0) -> None:
         from .metrics import REGISTRY
         self.path = path
         self.interval_s = max(interval_s, 0.1)
         self.registries = list(registries) if registries else [REGISTRY]
         self.trace_path = trace_path
+        self.max_mb = max_mb
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -140,6 +148,18 @@ class MetricsFlusher:
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
+        if self.max_mb > 0:
+            try:
+                # roll BEFORE the write that would breach the cap, so
+                # the live file never exceeds max_mb; os.replace is
+                # atomic — a reader sees the old or the new roll, never
+                # a half file
+                if (os.path.exists(self.path)
+                        and os.path.getsize(self.path) + len(line) + 1
+                        > self.max_mb * (1 << 20)):
+                    os.replace(self.path, self.path + ".1")
+            except OSError:  # pragma: no cover - rotation must not crash
+                pass
         with open(self.path, "a") as f:
             f.write(line + "\n")
 

@@ -12,7 +12,12 @@ explicit shed on overload), and speak newline-delimited data rows over
 threaded TCP (server.py, client.py — retrying, with `#health` /
 `#reload` control lines). Hot-reload swaps a newly-trained model in
 without a restart (reload.py); SIGTERM drains admitted work and exits 0
-(server.py drain). ``task=serve`` (__main__.py) is the CLI entry;
+(server.py drain). The continuity layer (ISSUE 5) removes the last
+restarts: a geometry-changing reload runs a blue/green executor swap
+(reload.py), `#handoff` + SO_REUSEPORT hand the port to a successor
+process with zero dropped traffic (server.py, tools/takeover.py), and
+ServeClient fails over across a replica endpoint list (client.py).
+``task=serve`` (__main__.py) is the CLI entry;
 tools/loadgen.py drives it open-loop; bench.py --serve tracks the
 latency/throughput/resilience trajectory; tests/test_chaos.py proves the
 failure paths under injected faults (utils/faultinject.py).
@@ -64,6 +69,14 @@ class ServeParam(Param):
     # a new generation in without a restart (0 = off; `#reload` over the
     # wire works either way — serve/reload.py)
     serve_reload_poll_s: float = field(default=0.0, metadata=dict(lo=0))
+    # bind the listening socket SO_REUSEPORT so a successor process can
+    # bind the SAME port while this replica drains (`#handoff`,
+    # tools/takeover.py). Every replica of a takeover pair needs it set,
+    # incumbent included — the kernel rejects mixed bindings.
+    serve_takeover: bool = False
+    # `#handoff <ready_file>`: wait at most this long for the successor
+    # before draining anyway (the handoff asked this replica to leave)
+    serve_handoff_wait_s: float = field(default=30.0, metadata=dict(lo=0))
     data_format: str = "libsvm"
     pred_prob: bool = True
 
@@ -90,9 +103,16 @@ def run_serve(kwargs: KWArgs) -> KWArgs:
         pred_prob=param.pred_prob, data_format=param.data_format,
         max_row_nnz=param.serve_max_row_nnz,
         report_every_s=param.serve_report_every,
-        drain_timeout_s=param.serve_drain_timeout_s)
+        drain_timeout_s=param.serve_drain_timeout_s,
+        takeover=param.serve_takeover,
+        handoff_wait_s=param.serve_handoff_wait_s)
+    server.ready_file = param.serve_ready_file
+    # server= attaches the blue/green path: a geometry-changing reload
+    # warms a second executor and swaps it under the batcher instead of
+    # failing (serve/reload.py)
     reloader = ModelReloader(server.executor, param.model_in,
-                             poll_s=param.serve_reload_poll_s)
+                             poll_s=param.serve_reload_poll_s,
+                             server=server)
     server.reloader = reloader
     # signal.signal only works on the main thread; tests drive run_serve
     # from worker threads and manage shutdown themselves

@@ -165,7 +165,10 @@ class MicroBatcher:
     thread (the executor's dispatch contract). ``queue_cap`` bounds
     admission in ROWS of queued work, the quantity that actually sets
     queueing delay (a row costs what a row costs, however the requests
-    arrive grouped).
+    arrive grouped). ``predict_fn`` is re-read at every flush, which is
+    what makes the blue/green executor swap one attribute assignment
+    (server.swap_executor): the in-flight batch finishes on the function
+    it started with, the next flush dispatches on the replacement.
     """
 
     def __init__(self, predict_fn: Callable[[RowBlock], np.ndarray],
@@ -271,6 +274,9 @@ class MicroBatcher:
                     self._rows_queued -= rows
                 self.stats.record_batch(rows, self._rows_queued)
                 try:
+                    # one attribute read per flush: a concurrent
+                    # swap_executor retargets the NEXT flush, never
+                    # splits this one
                     scores = self.predict_fn(
                         RowBlock.concat([b for b, _, _ in batch]))
                 except Exception as e:  # pragma: no cover - executor bug

@@ -1,5 +1,5 @@
 """Python client for the serving front-end (serve/server.py), with
-transparent retry.
+transparent retry and multi-endpoint failover.
 
 Speaks the newline protocol: send data rows, read one response line per
 row in order. ``predict`` returns probabilities (or raw margins when the
@@ -8,11 +8,20 @@ server runs pred_prob=false) as floats.
 Resilience contract (the client half of the serve lifecycle):
 
 - **connect/read failures retry** with capped exponential backoff + full
-  jitter, up to ``retries`` reconnect attempts per call and never past
-  the per-call ``deadline_s``. Responses arrive in request order, so on a
-  dropped connection the client knows exactly which rows were answered
-  and resends only the tail (scoring is pure — a row scored twice
-  server-side is harmless).
+  jitter, up to ``retries`` reconnect attempts PER ENDPOINT and never
+  past the per-call ``deadline_s``. Responses arrive in request order,
+  so on a dropped connection the client knows exactly which rows were
+  answered and resends only the tail (scoring is pure — a row scored
+  twice server-side is harmless).
+- **multi-endpoint failover**: construct with ``endpoints=`` (a list of
+  ``(host, port)`` pairs or an ``"h1:p1,h2:p2"`` string —
+  config.parse_endpoints) and a failure fails the unanswered tail over
+  to the NEXT replica immediately, no backoff nap while a healthy
+  replica is available. Per-endpoint health is tracked: ``eject_after``
+  consecutive failures eject an endpoint for ``reprobe_s`` seconds
+  (timed re-probe — the first use after the window IS the probe); when
+  every endpoint is ejected the least-recently-ejected one is tried
+  anyway (a client never deadlocks itself into "no replicas").
 - ``!shed`` (queue full, or a draining replica) is **retryable**: the
   server explicitly asked for the row again later, so ``predict`` backs
   off and resends just the shed rows within the same budget.
@@ -20,7 +29,8 @@ Resilience contract (the client half of the serve lifecycle):
   retryable**: the same bytes would fail the same way; it surfaces as
   None immediately.
 
-``retries=0`` (default) keeps the old fail-fast behavior byte-for-byte.
+``retries=0`` (default) keeps the old fail-fast behavior byte-for-byte
+for a single endpoint; with N endpoints it means one try per replica.
 """
 
 from __future__ import annotations
@@ -31,6 +41,8 @@ import socket
 import time
 from typing import List, Optional, Sequence, Union
 
+from ..config import parse_endpoints
+
 Line = Union[str, bytes]
 
 
@@ -39,12 +51,36 @@ def _to_bytes(line: Line) -> bytes:
     return b if b.endswith(b"\n") else b + b"\n"
 
 
+class _Endpoint:
+    """Per-replica health: consecutive failures + ejection window."""
+
+    __slots__ = ("host", "port", "fails", "down_until")
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, int(port)
+        self.fails = 0
+        self.down_until = 0.0
+
+
 class ServeClient:
-    def __init__(self, host: str, port: int, timeout: float = 60.0,
+    def __init__(self, host: Optional[str] = None,
+                 port: Optional[int] = None, timeout: float = 60.0,
                  retries: int = 0, backoff_s: float = 0.05,
                  backoff_max_s: float = 2.0,
-                 deadline_s: Optional[float] = None):
-        self.host, self.port = host, port
+                 deadline_s: Optional[float] = None,
+                 endpoints=None, eject_after: int = 3,
+                 reprobe_s: float = 5.0):
+        if endpoints is not None:
+            eps = parse_endpoints(endpoints)
+        elif host is not None and port is not None:
+            eps = [(host, int(port))]
+        else:
+            raise ValueError("pass host+port or endpoints=[(h, p), ...]")
+        self._eps = [_Endpoint(h, p) for h, p in eps]
+        self._cur = 0
+        self.eject_after = eject_after
+        self.reprobe_s = reprobe_s
+        self.failovers = 0           # times the active endpoint moved
         self.timeout = timeout
         self.retries = retries
         self.backoff_s = backoff_s
@@ -53,11 +89,29 @@ class ServeClient:
         self._rng = random.Random(0x5E12E)
         self._sock: Optional[socket.socket] = None
         self._rfile = None
-        # constructor connect honors the same retry budget: a client
-        # racing a replica restart should wait for it, not crash
-        self._ensure_conn(self._deadline())
+        # constructor connect honors the same retry/failover budget: a
+        # client racing a replica restart should wait for it, not crash
+        self._ensure_conn(self._deadline(), {})
 
     # ------------------------------------------------------------- conn
+    @property
+    def host(self) -> str:
+        """Host of the endpoint currently in use."""
+        return self._eps[self._cur].host
+
+    @property
+    def port(self) -> int:
+        return self._eps[self._cur].port
+
+    def endpoints_health(self) -> List[dict]:
+        """Per-endpoint view: consecutive failures + ejection state —
+        what a fleet debugger prints when a replica list degrades."""
+        now = time.monotonic()
+        return [{"host": e.host, "port": e.port, "fails": e.fails,
+                 "ejected": e.down_until > now,
+                 "active": i == self._cur}
+                for i, e in enumerate(self._eps)]
+
     def _deadline(self) -> Optional[float]:
         return (time.monotonic() + self.deadline_s
                 if self.deadline_s is not None else None)
@@ -77,6 +131,42 @@ class ServeClient:
             delay = min(delay, remaining)
         time.sleep(delay)
 
+    def _note_success(self) -> None:
+        ep = self._eps[self._cur]
+        ep.fails = 0
+        ep.down_until = 0.0
+
+    def _failover(self, attempts: dict, deadline: Optional[float],
+                  err: BaseException) -> None:
+        """Record a failure on the active endpoint and pick the next one
+        for this call. Ejects the endpoint after ``eject_after``
+        consecutive failures (re-probed after ``reprobe_s``). Moving to
+        a fresh replica is immediate; re-trying one already attempted
+        this call backs off on ITS attempt count (per-endpoint backoff
+        semantics). Re-raises ``err`` once every endpoint is out of
+        budget."""
+        i = self._cur
+        ep = self._eps[i]
+        ep.fails += 1
+        if ep.fails >= self.eject_after:
+            ep.down_until = time.monotonic() + self.reprobe_s
+        attempts[i] = attempts.get(i, 0) + 1
+        n = len(self._eps)
+        order = [(i + k) % n for k in range(1, n + 1)]  # others first
+        cands = [j for j in order if attempts.get(j, 0) <= self.retries]
+        if not cands:
+            raise err
+        now = time.monotonic()
+        healthy = [j for j in cands if self._eps[j].down_until <= now]
+        j = healthy[0] if healthy else \
+            min(cands, key=lambda k: self._eps[k].down_until)
+        if j != i:
+            self.failovers += 1
+        self._cur = j
+        a = attempts.get(j, 0)
+        if a > 0:
+            self._backoff(a - 1, deadline)
+
     def _drop_conn(self) -> None:
         if self._rfile is not None:
             try:
@@ -91,14 +181,17 @@ class ServeClient:
                 pass
             self._sock = None
 
-    def _ensure_conn(self, deadline: Optional[float]) -> None:
+    def _ensure_conn(self, deadline: Optional[float],
+                     attempts: Optional[dict] = None) -> None:
         if self._sock is not None:
             return
-        attempt = 0
+        if attempts is None:
+            attempts = {}
         while True:
+            ep = self._eps[self._cur]
             try:
                 self._sock = socket.create_connection(
-                    (self.host, self.port), timeout=self.timeout)
+                    (ep.host, ep.port), timeout=self.timeout)
                 try:
                     self._sock.setsockopt(socket.IPPROTO_TCP,
                                           socket.TCP_NODELAY, 1)
@@ -106,28 +199,26 @@ class ServeClient:
                     pass
                 self._rfile = self._sock.makefile("rb")
                 return
-            except OSError:
+            except OSError as e:
                 self._drop_conn()
-                if attempt >= self.retries:
-                    raise
-                self._backoff(attempt, deadline)
-                attempt += 1
+                self._failover(attempts, deadline, e)
 
     # ------------------------------------------------------------- io
     def score_lines(self, lines: Sequence[Line]) -> List[bytes]:
         """Pipeline a batch of request rows; returns the raw response
         line per row (no trailing newline), in request order. For very
         large batches prefer several calls — the whole request block is
-        written before responses are drained. Reconnects and resends the
-        unanswered tail on connection failures (see module docstring)."""
+        written before responses are drained. Reconnects — to the next
+        replica when more than one endpoint is configured — and resends
+        the unanswered tail on connection failures (module docstring)."""
         pending = [_to_bytes(l) for l in lines]
         out: List[bytes] = []
         deadline = self._deadline()
-        attempt = 0
+        attempts: dict = {}
         while pending:
             answered = 0
             try:
-                self._ensure_conn(deadline)
+                self._ensure_conn(deadline, attempts)
                 self._sock.sendall(b"".join(pending))
                 for _ in range(len(pending)):
                     resp = self._rfile.readline()
@@ -136,16 +227,14 @@ class ServeClient:
                             "server closed the connection")
                     out.append(resp.rstrip(b"\n"))
                     answered += 1
+                self._note_success()
                 return out
-            except (OSError, ConnectionError):
+            except (OSError, ConnectionError) as e:
                 # in-order responses: rows already appended to ``out``
                 # are answered for good; only the tail resends
                 pending = pending[answered:]
                 self._drop_conn()
-                if attempt >= self.retries:
-                    raise
-                self._backoff(attempt, deadline)
-                attempt += 1
+                self._failover(attempts, deadline, e)
         return out
 
     def predict(self, lines: Sequence[Line]) -> List[Optional[float]]:
@@ -190,10 +279,10 @@ class ServeClient:
         line (the exposition format never emits blank lines itself), so
         this reads until that sentinel instead of one line per request."""
         deadline = self._deadline()
-        attempt = 0
+        attempts: dict = {}
         while True:
             try:
-                self._ensure_conn(deadline)
+                self._ensure_conn(deadline, attempts)
                 self._sock.sendall(b"#metrics\n")
                 lines = []
                 while True:
@@ -202,21 +291,29 @@ class ServeClient:
                         raise ConnectionError(
                             "server closed the connection")
                     if resp == b"\n":
+                        self._note_success()
                         return b"".join(lines).decode()
                     if not lines and resp.startswith(b"!err"):
                         raise RuntimeError(resp.rstrip(b"\n").decode())
                     lines.append(resp)
-            except (OSError, ConnectionError):
+            except (OSError, ConnectionError) as e:
                 self._drop_conn()
-                if attempt >= self.retries:
-                    raise
-                self._backoff(attempt, deadline)
-                attempt += 1
+                self._failover(attempts, deadline, e)
 
     def reload(self, path: Optional[str] = None) -> dict:
         """Trigger a synchronous model hot-reload (#reload [path]);
         returns the server's {'ok', 'model_generation'|'error'} verdict."""
         line = b"#reload" if path is None else b"#reload " + path.encode()
+        return json.loads(self.score_lines([line])[0])
+
+    def handoff(self, ready_file: str = "") -> dict:
+        """Ask THIS connection's replica to hand its port off
+        (#handoff): it waits for ``ready_file`` (the successor's
+        serve_ready_file), then drains. Hold the connection open from
+        before the successor binds so the request provably reaches the
+        incumbent (tools/takeover.py)."""
+        line = b"#handoff" + (b" " + ready_file.encode()
+                              if ready_file else b"")
         return json.loads(self.score_lines([line])[0])
 
     def close(self) -> None:

@@ -72,6 +72,7 @@ class PredictExecutor:
         self._mu = threading.Lock()
         self._buckets: dict = {}   # statics key -> dispatch count
         self._dispatches = 0
+        self._warmed = 0           # buckets compiled by warm_bucket()
         # hot-reload bookkeeping (serve/reload.py swaps stores in)
         self.generation = 1
 
@@ -80,14 +81,59 @@ class PredictExecutor:
         """{'buckets_compiled', 'bucket_hits', 'dispatches',
         'model_generation'}: compiled grows only at a bucket's first
         occurrence; a steady-state window adds hits only (zero
-        recompiles); model_generation advances once per hot reload."""
+        recompiles); model_generation advances once per hot reload.
+        Warm-replayed buckets (warm_bucket) compiled without consuming a
+        dispatch, so they don't deflate the hit count."""
         with self._mu:
             return {
                 "buckets_compiled": len(self._buckets),
-                "bucket_hits": self._dispatches - len(self._buckets),
+                "bucket_hits": self._dispatches
+                - (len(self._buckets) - self._warmed),
                 "dispatches": self._dispatches,
                 "model_generation": self.generation,
             }
+
+    # ------------------------------------------------------- warm replay
+    def warm_set(self) -> Tuple[dict, list]:
+        """(shape-cap snapshot, compiled bucket keys) — everything a
+        blue/green successor needs to pre-compile the exact programs this
+        executor serves with (serve/reload.py): the caps make future
+        batches pad to the same buckets, the keys are the buckets to
+        compile before the swap."""
+        with self._mu:
+            return self._shapes.snapshot(), list(self._buckets)
+
+    def seed_caps(self, caps: dict) -> None:
+        """Adopt another executor's sticky shape caps, so every batch
+        shape the predecessor served maps to the same bucket here (a
+        batch that was a HIT there stays a hit after the swap)."""
+        self._shapes.absorb(caps)
+
+    def warm_bucket(self, key: Tuple[int, int, int, bool]) -> None:
+        """Compile the predict program for one recorded bucket key by
+        dispatching a synthetic single-row batch padded to its caps —
+        identical statics to a real dispatch, so the jit cache entry a
+        later request needs already exists. Registers the key without
+        counting a dispatch (stats arithmetic stays honest)."""
+        b_cap, nnz_cap, u_cap, binary = key
+        store = self.store
+        blk = RowBlock(
+            offset=np.array([0, 1], dtype=np.int64),
+            label=np.zeros(1, dtype=np.float32),
+            index=np.zeros(1, dtype=np.uint32),
+            value=None if binary else np.ones(1, dtype=np.float32),
+            weight=None)
+        padded = pad_slots_oob(np.zeros(1, dtype=np.int32), u_cap,
+                               store.state.capacity)
+        i32, f32, _ = pack_batch(blk, 1, padded, b_cap, nnz_cap, u_cap)
+        pred, _, _ = self._packed(store.state, jnp.asarray(i32),
+                                  jnp.asarray(f32), b_cap, nnz_cap, u_cap,
+                                  binary)
+        jax.block_until_ready(pred)
+        with self._mu:
+            if key not in self._buckets:
+                self._buckets[key] = 0
+                self._warmed += 1
 
     # ------------------------------------------------------------- swap
     def swap_store(self, store: SlotStore) -> int:
@@ -95,20 +141,24 @@ class PredictExecutor:
         serve hot-reload commit point). The jitted programs were built
         from make_fns(param) — pure functions of the updater params — so
         the replacement must match the geometry they were compiled
-        against; a mismatched reload is rejected here and the old model
-        keeps serving. The swap itself is one attribute assignment:
-        ``predict`` snapshots ``self.store`` once per call, so in-flight
-        batches finish on the model they started with."""
+        against; a mismatched reload is rejected here (the old model
+        keeps serving) and the caller routes it through the blue/green
+        second-executor swap instead (serve/reload.py). The swap itself
+        is one attribute assignment: ``predict`` snapshots ``self.store``
+        once per call, so in-flight batches finish on the model they
+        started with."""
+        from .model import store_geometry
         old = self.store
-        if (store.param.V_dim != old.param.V_dim
-                or store.param.hash_capacity != old.param.hash_capacity):
+        if store_geometry(store.param) != store_geometry(old.param):
             raise ValueError(
                 f"hot-reload geometry mismatch: serving "
                 f"(V_dim={old.param.V_dim}, "
                 f"hash_capacity={old.param.hash_capacity}) vs new model "
                 f"(V_dim={store.param.V_dim}, "
-                f"hash_capacity={store.param.hash_capacity}); restart the "
-                "server to change model geometry")
+                f"hash_capacity={store.param.hash_capacity}); in-place "
+                "swap keeps the compiled programs, so a geometry change "
+                "must go through the blue/green executor swap "
+                "(serve/reload.py, requires a server-attached reloader)")
         with self._mu:
             self.store = store
             self.generation += 1

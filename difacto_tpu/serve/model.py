@@ -25,6 +25,15 @@ from ..utils import stream
 log = logging.getLogger("difacto_tpu")
 
 
+def store_geometry(param) -> Tuple[int, int]:
+    """(V_dim, hash_capacity) — the contract the compiled predict
+    programs were traced against (step.py make_predict_fn over
+    make_fns(param)). An in-place hot reload (serve/executor.py
+    swap_store) requires it unchanged; a mismatch routes through the
+    blue/green executor swap (serve/reload.py) instead of a restart."""
+    return (param.V_dim, param.hash_capacity)
+
+
 def resolve_model_path(uri: str) -> str:
     """The actual checkpoint file behind a model prefix: learners append
     ``_part-<rank>`` (sgd, store/local.py) or ``.npz`` (lbfgs/bcd)."""

@@ -21,7 +21,20 @@ executor, recompiled buckets, and a dropped listening socket. The
 - a failed or corrupt load **keeps the old model serving** and records
   ``reload_failures``; ``#stats`` carries ``model_generation`` /
   ``reloads`` / ``reload_failures`` so a fleet can alert on a replica
-  that's stuck behind the model it should be serving.
+  that's stuck behind the model it should be serving;
+- a **geometry change** (``V_dim`` / ``hash_capacity`` moved between
+  generations) no longer forces a restart: when the reloader is attached
+  to a server it runs a **blue/green executor swap** — a second
+  ``PredictExecutor`` is built against the new store, seeded with the
+  live executor's sticky shape caps and warmed on every bucket the live
+  executor has compiled (its recorded warm-set, so no request ever pays
+  a compile on green), then the server's executor reference is swapped
+  atomically: in-flight batches finish on blue, the next flush runs on
+  green, and blue's store/buffers drop with the last reference.
+  ``swap_state`` (idle/warming/swapping) rides ``#health``/``#stats``
+  and ``serve_bluegreen_swaps_total`` counts the swaps; ``reload.warm``
+  is a chaos injection point inside the warm loop
+  (utils/faultinject.py).
 """
 
 from __future__ import annotations
@@ -31,24 +44,38 @@ import threading
 import time
 from typing import Optional, Tuple
 
-from ..utils import stream
+from ..utils import faultinject, stream
 
 log = logging.getLogger("difacto_tpu")
 
 
 class ModelReloader:
     def __init__(self, executor, model_uri: str, poll_s: float = 0.0,
-                 kwargs=()):
-        self.executor = executor
+                 kwargs=(), server=None):
+        # server=None (bench/unit use): same-geometry swaps only — there
+        # is no batcher whose executor reference a blue/green swap could
+        # retarget, so a geometry change stays a reload failure
+        self._executor = executor
+        self._server = server
         self.model_uri = model_uri
         self.poll_s = poll_s
         self._kwargs = list(kwargs)
         self.reloads = 0
         self.reload_failures = 0
+        self.bluegreen_swaps = 0
+        self.swap_state = "idle"             # idle | warming | swapping
         self._reload_mu = threading.Lock()   # serialize concurrent reloads
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._cur = self._fingerprint()
+
+    @property
+    def executor(self):
+        """The LIVE executor — read through the server when attached,
+        because a blue/green swap replaces the server's executor object
+        and a cached reference would keep reloading into a dead blue."""
+        return (self._server.executor if self._server is not None
+                else self._executor)
 
     # ------------------------------------------------------------ watch
     def _fingerprint(self) -> Optional[Tuple]:
@@ -105,7 +132,7 @@ class ModelReloader:
         """Load + verify + swap, synchronously on the calling thread.
         Returns {'ok', 'model_generation'} or {'ok': False, 'error'} —
         the old model keeps serving on any failure."""
-        from .model import open_serving_store
+        from .model import open_serving_store, store_geometry
         target = path or self.model_uri
         with self._reload_mu:
             fp = self._fingerprint() if path is None else None
@@ -115,7 +142,13 @@ class ModelReloader:
                 # the fallback
                 store, meta, _ = open_serving_store(target, self._kwargs,
                                                     fallback=False)
-                gen = self.executor.swap_store(store)
+                blue = self.executor
+                if (store_geometry(store.param)
+                        != store_geometry(blue.store.param)
+                        and self._server is not None):
+                    gen = self._bluegreen_swap(blue, store)
+                else:
+                    gen = blue.swap_store(store)
             except Exception as e:
                 self.reload_failures += 1
                 from ..obs import counter
@@ -135,7 +168,45 @@ class ModelReloader:
             return {"ok": True, "model_generation": gen,
                     "path": meta["path"]}
 
+    # ------------------------------------------------------- blue/green
+    def _bluegreen_swap(self, blue, store) -> int:
+        """Geometry-changing swap: build + warm a green executor, then
+        retarget the server atomically. Runs on the reloading thread
+        (watcher or a connection reader) — scoring keeps flowing through
+        blue on the batcher thread the whole time. Any failure (corrupt
+        warm, injected ``reload.warm`` fault) propagates to the reload
+        failure path: green is dropped, blue keeps serving."""
+        from .executor import PredictExecutor
+        self.swap_state = "warming"
+        try:
+            caps, keys = blue.warm_set()
+            log.info("blue/green: warming %d buckets for geometry "
+                     "(V_dim=%d, hash_capacity=%d)", len(keys),
+                     store.param.V_dim, store.param.hash_capacity)
+            green = PredictExecutor(store)
+            green.seed_caps(caps)
+            for key in keys:
+                # chaos point: err aborts the swap (blue keeps serving),
+                # delay_ms stretches the warm window (the drain-vs-reload
+                # race tests live here)
+                faultinject.fire("reload.warm")
+                green.warm_bucket(key)
+            self.swap_state = "swapping"
+            green.generation = blue.generation + 1
+            self._server.swap_executor(green)
+            self.bluegreen_swaps += 1
+            self._server.obs.counter(
+                "serve_bluegreen_swaps_total",
+                "geometry-changing blue/green executor swaps").inc()
+            log.info("blue/green: swapped to generation %d (%d buckets "
+                     "warm)", green.generation, len(keys))
+            return green.generation
+        finally:
+            self.swap_state = "idle"
+
     # ------------------------------------------------------------ stats
     def stats(self) -> dict:
         return {"reloads": self.reloads,
-                "reload_failures": self.reload_failures}
+                "reload_failures": self.reload_failures,
+                "bluegreen_swaps": self.bluegreen_swaps,
+                "swap_state": self.swap_state}

@@ -14,7 +14,15 @@ Wire protocol (newline-delimited, UTF-8/ASCII):
   with a blank line so line-oriented clients know where it stops;
 - ``!shed`` -> the admission queue was full (overload backpressure —
   resend later or slow down);
-- ``!err <reason>`` -> the row was rejected (malformed, oversized).
+- ``!err <reason>`` -> the row was rejected (malformed, oversized);
+- ``#handoff [ready_file]`` -> zero-downtime replica takeover: reply
+  immediately, then (on a background thread) wait for the successor's
+  ready file and drain. With ``takeover=True`` the listening socket is
+  bound ``SO_REUSEPORT``, so a successor process binds the SAME port
+  while the incumbent drains — established connections stay with their
+  owner, new connections land on whichever replica still listens
+  (tools/takeover.py sequences spawn -> warm -> handoff -> exit;
+  ``serve.handoff`` is a chaos injection point).
 
 One reader + one writer thread per connection: the reader parses and
 admits rows into the shared MicroBatcher, the writer resolves futures in
@@ -28,6 +36,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
 import socket
 import threading
@@ -35,7 +44,7 @@ import time
 from typing import Optional
 
 from ..data.parsers import get_parser
-from ..utils import faultinject
+from ..utils import faultinject, stream
 from ..utils.reporter import Reporter
 from .batcher import MicroBatcher, ServeStats
 from .executor import PredictExecutor, sigmoid
@@ -50,7 +59,8 @@ class ServeServer:
                  pred_prob: bool = True, data_format: str = "libsvm",
                  max_row_nnz: int = 4096, report_every_s: float = 30.0,
                  reporter: Optional[Reporter] = None,
-                 drain_timeout_s: float = 10.0):
+                 drain_timeout_s: float = 10.0, takeover: bool = False,
+                 handoff_wait_s: float = 30.0):
         self.executor = PredictExecutor(store, loss=loss)
         if reporter is None:
             reporter = Reporter(every=1)
@@ -73,8 +83,22 @@ class ServeServer:
         # the #reload control line and the background model watcher
         self.reloader = None
         self.draining = False
+        # takeover state (#handoff): ready_file is set by run_serve so a
+        # handoff addressed at "our own" ready file is recognized as
+        # mis-routed (SO_REUSEPORT may hash a fresh connection to the
+        # successor); successor_ready surfaces through #health
+        self.takeover = takeover
+        self.handoff_wait_s = handoff_wait_s
+        self.ready_file = ""
+        self.successor_ready = False
+        self._successor_file: Optional[str] = None
+        self._handoff_thread: Optional[threading.Thread] = None
         self._parser = get_parser(data_format)
-        self._sock = socket.create_server((host, port))
+        # SO_REUSEPORT (takeover): every replica of a takeover pair must
+        # bind with it set, incumbent included — the kernel rejects mixed
+        # bindings — so the knob is on the server, not the handoff
+        self._sock = socket.create_server((host, port),
+                                          reuse_port=takeover)
         self._sock.settimeout(0.25)
         self.host, self.port = self._sock.getsockname()[:2]
         self._alive = False
@@ -126,8 +150,12 @@ class ServeServer:
                 c.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
+        # a conn thread can reach here through #handoff -> drain ->
+        # close; never join the calling thread itself
+        me = threading.current_thread()
         for t in self._conn_threads:
-            t.join()
+            if t is not me:
+                t.join()
         self._conn_threads.clear()
         self.batcher.close()
 
@@ -162,6 +190,15 @@ class ServeServer:
         self.close()
         return time.monotonic() - t0
 
+    def swap_executor(self, new) -> None:
+        """Blue/green commit point (serve/reload.py): retarget the
+        server AND the batcher at the green executor in two attribute
+        assignments. The batcher reads ``predict_fn`` afresh per flush,
+        so the in-flight batch finishes on blue and the next flush runs
+        on green; blue's store/buffers drop with the last reference."""
+        self.executor = new
+        self.batcher.predict_fn = new.predict_scores
+
     def stats_snapshot(self) -> dict:
         """Serving counters + executor bucket stats (incl.
         model_generation) + reload counters, one flat dict."""
@@ -172,13 +209,27 @@ class ServeServer:
 
     def health_snapshot(self) -> dict:
         """The ``#health`` payload: readiness for load-balancer rotation
-        plus the queue depth that predicts admission latency."""
-        return {
+        plus the queue depth that predicts admission latency. ``pid`` /
+        ``server_id`` identify WHICH replica answered — under a
+        SO_REUSEPORT takeover two processes share the port, and the
+        handoff driver polls this one endpoint until the successor's id
+        answers ready. ``swap_state`` (idle/warming/swapping) and
+        ``successor_ready`` (present once a #handoff is pending) let one
+        poll loop watch both continuity paths."""
+        out = {
             "status": "draining" if self.draining else "ready",
             "queue_depth": self.batcher.rows_queued,
             "queue_cap": self.batcher.queue_cap,
             "model_generation": self.executor.generation,
+            "pid": os.getpid(),
+            "server_id": f"{os.getpid()}.{id(self):x}",
+            "takeover": self.takeover,
+            "swap_state": (self.reloader.swap_state
+                           if self.reloader is not None else "idle"),
         }
+        if self._successor_file is not None:
+            out["successor_ready"] = self.successor_ready
+        return out
 
     # ------------------------------------------------------- connection
     def _accept_loop(self) -> None:
@@ -307,6 +358,10 @@ class ServeServer:
             self.obs.gauge("serve_reload_failures",
                            "failed model hot-reloads (old model kept)"
                            ).set(rs["reload_failures"])
+            self.obs.gauge("serve_swap_warming",
+                           "1 while a blue/green warm or swap is in "
+                           "flight").set(
+                0.0 if rs["swap_state"] == "idle" else 1.0)
         snap = merge_into(self.obs.snapshot(), REGISTRY.snapshot())
         return render_prometheus(snap)
 
@@ -319,6 +374,8 @@ class ServeServer:
             return self.metrics_text().encode() + b"\n"
         if line == b"#health":
             return (json.dumps(self.health_snapshot()) + "\n").encode()
+        if line == b"#handoff" or line.startswith(b"#handoff "):
+            return self._control_handoff(line)
         if line == b"#reload" or line.startswith(b"#reload "):
             # synchronous on THIS connection's reader thread: scoring
             # traffic on other connections keeps flowing through the
@@ -328,6 +385,56 @@ class ServeServer:
             path = line[len(b"#reload"):].strip().decode() or None
             return (json.dumps(self.reloader.reload(path)) + "\n").encode()
         return b"!err unknown control %s\n" % line[:32]
+
+    def _control_handoff(self, line: bytes) -> bytes:
+        """``#handoff [ready_file]``: acknowledge, then wait for the
+        successor and drain on a BACKGROUND thread — the drain path
+        close()s connections and joins their threads, so it must never
+        run on the requesting connection's own reader thread."""
+        try:
+            faultinject.act_default(faultinject.fire("serve.handoff"))
+        except faultinject.FaultInjected as e:
+            return b"!err %s\n" % str(e).encode()
+        arg = line[len(b"#handoff"):].strip().decode()
+        if arg and self.ready_file and \
+                os.path.abspath(arg) == os.path.abspath(self.ready_file):
+            # SO_REUSEPORT hashed this connection to the successor: the
+            # named ready file is OUR OWN — refuse, the driver retries
+            # on the connection it holds to the incumbent
+            return (b"!err handoff addressed to the successor "
+                    b"(this replica owns the ready file)\n")
+        with self._mu:
+            if self._handoff_thread is not None:
+                return (json.dumps({"ok": True, "state": "draining"})
+                        + "\n").encode()
+            self._successor_file = arg
+            t = threading.Thread(target=self._handoff, args=(arg,),
+                                 name="serve-handoff", daemon=True)
+            self._handoff_thread = t
+        t.start()
+        return (json.dumps({"ok": True, "state": "handoff",
+                            "successor_file": arg}) + "\n").encode()
+
+    def _handoff(self, ready_file: str) -> None:
+        """Wait (bounded by ``handoff_wait_s``) for the successor's
+        ready file, then drain. A successor that never appears does not
+        pin the incumbent forever: the handoff was an explicit operator
+        request to leave, so after the wait budget we drain anyway —
+        loudly."""
+        end = time.monotonic() + self.handoff_wait_s
+        if ready_file:
+            while (not stream.isfile(ready_file)
+                   and time.monotonic() < end and not self._closed):
+                time.sleep(0.05)
+            self.successor_ready = stream.isfile(ready_file)
+            if not self.successor_ready and not self._closed:
+                log.warning("handoff: successor never became ready "
+                            "(%s); draining anyway", ready_file)
+        else:
+            self.successor_ready = True
+        log.info("handoff: draining incumbent (successor_ready=%s)",
+                 self.successor_ready)
+        self.drain()
 
     def _writer(self, conn: socket.socket, replies: "queue.Queue") -> None:
         try:

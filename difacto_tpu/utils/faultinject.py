@@ -15,7 +15,12 @@ run of a real cluster) arm through one environment variable:
   ``step.device`` (the host-side dispatch of a fused device step,
   step.py fire_step_fault — a poisoned program / device loss stand-in),
   ``dcn.collective`` (the cross-host control-plane exchange,
-  parallel/multihost.py — a dead-coordinator / partition stand-in).
+  parallel/multihost.py — a dead-coordinator / partition stand-in),
+  ``serve.handoff`` (the #handoff takeover control line, serve/
+  server.py — a botched replica rotation stand-in), ``reload.warm``
+  (each bucket of a blue/green warm loop, serve/reload.py — ``err``
+  aborts the swap with the old model still serving, ``delay_ms``
+  stretches the warm window for drain-race tests).
 - ``kind`` — what happens when the fault fires:
     - ``err``      raise :class:`FaultInjected` (an OSError, so IO call
                    sites treat it exactly like a real IO failure);

@@ -590,6 +590,313 @@ def test_single_pass_verified_load(ckpt_model, monkeypatch):
         assert st_c.num_features == 0 and st_c.state.capacity == cap0
 
 
+# ------------------------------------- serving continuity (ISSUE 5)
+
+def _synth_model(dirpath, name: str, vdim: int, capacity: int = 4096):
+    """A saved synthetic hashed model (manifest-stamped via store.save).
+    Geometry comes from the args, so two calls with different ``vdim``
+    give a geometry-changing reload its before/after pair without two
+    training runs."""
+    from difacto_tpu.store.local import SlotStore
+    from difacto_tpu.updaters.sgd_updater import (SGDUpdaterParam,
+                                                  set_all_live)
+
+    param = SGDUpdaterParam(V_dim=vdim, l1_shrk=False,
+                            hash_capacity=capacity)
+    st = SlotStore(param, read_only=True)
+    st.state = set_all_live(param, st.state)
+    path = os.path.join(str(dirpath), name)
+    st.save(path)
+    return path
+
+
+def _synth_rows(n_rows: int = 128, nnz: int = 8, space: int = 1 << 14,
+                seed: int = 0) -> list:
+    """Synthetic libsvm request rows with a FIXED nnz per row, so every
+    single-row dispatch lands in one deterministic shape bucket."""
+    rng = np.random.RandomState(seed)
+    return [("0 " + " ".join(
+        f"{i}:1" for i in np.sort(rng.choice(space, nnz,
+                                             replace=False)))).encode()
+        for _ in range(n_rows)]
+
+
+def test_bluegreen_swap_under_load(tmp_path):
+    """Acceptance (ISSUE 5 leg 1): a geometry-changing reload
+    (different V_dim) under open-loop load runs the blue/green executor
+    swap with ZERO !err replies; every bucket the live executor had
+    compiled is pre-warmed on green before traffic can reach it, and
+    serve_bluegreen_swaps_total counts exactly 1."""
+    sys.path.insert(0, str(REPO / "tools"))
+    from loadgen import run_loadgen
+
+    from difacto_tpu.serve import (ModelReloader, ServeClient,
+                                   ServeServer, open_serving_store)
+
+    model_a = _synth_model(tmp_path, "ma", vdim=4)
+    model_b = _synth_model(tmp_path, "mb", vdim=8)
+    rows = _synth_rows()
+    with deadline(300):
+        store, _, _ = open_serving_store(model_a)
+        srv = ServeServer(store, batch_size=64, max_delay_ms=2.0).start()
+        srv.reloader = ModelReloader(srv.executor, model_a, server=srv)
+        rep = {}
+        t = threading.Thread(target=lambda: rep.update(
+            run_loadgen(srv.host, srv.port, rows, qps=200,
+                        duration_s=4.0)))
+        try:
+            t.start()
+            time.sleep(1.0)
+            blue = srv.executor
+            _, warm_keys = blue.warm_set()
+            assert warm_keys, "no traffic compiled before the swap"
+            with ServeClient(srv.host, srv.port) as c:
+                assert c.health()["swap_state"] == "idle"
+                res = c.reload(model_b)
+                assert res["ok"] and res["model_generation"] == 2, res
+                green = srv.executor
+                assert green is not blue
+                assert green.store.param.V_dim == 8
+                # warm-set replay: every blue bucket was registered on
+                # green BY THE WARM LOOP, before any request hit it
+                assert set(warm_keys) <= set(green._buckets)
+                assert green._warmed >= len(warm_keys)
+                st = c.stats()
+                assert st["bluegreen_swaps"] == 1, st
+                assert st["model_generation"] == 2
+                assert st["swap_state"] == "idle"
+                assert "serve_bluegreen_swaps_total 1" in c.metrics()
+            t.join()
+            # single-row requests pad to the live sticky caps — the
+            # exact bucket key blue compiled and the swap warmed — so
+            # green serves them with ZERO steady-state compiles
+            base = green.stats()["buckets_compiled"]
+            with ServeClient(srv.host, srv.port) as c:
+                for r in rows[:5]:
+                    assert c.predict([r])[0] is not None
+                    time.sleep(0.02)
+            assert green.stats()["buckets_compiled"] == base
+        finally:
+            srv.close()
+        assert rep["err"] == 0, rep     # zero client-visible errors
+        assert rep["ok"] > 0, rep       # traffic flowed through the swap
+
+
+def test_reuseport_takeover_kills_incumbent_under_load(tmp_path):
+    """Acceptance (leg 2): two replicas share one SO_REUSEPORT port;
+    the incumbent is killed ABRUPTLY (no drain) mid-load and the
+    multi-endpoint failover client sees zero errors — dropped tails
+    reconnect onto the successor."""
+    sys.path.insert(0, str(REPO / "tools"))
+    from loadgen import run_loadgen_failover
+
+    from difacto_tpu.serve import ServeServer, open_serving_store
+
+    model = _synth_model(tmp_path, "m", vdim=4)
+    rows = _synth_rows()
+    with deadline(300):
+        store, _, _ = open_serving_store(model)
+        srv1 = ServeServer(store, batch_size=64, max_delay_ms=2.0,
+                           takeover=True).start()
+        # one logical service, two replica slots behind the same
+        # address — the client treats them as a failover list
+        endpoints = [(srv1.host, srv1.port), (srv1.host, srv1.port)]
+        rep = {}
+        t = threading.Thread(target=lambda: rep.update(
+            run_loadgen_failover(endpoints, rows, qps=120,
+                                 duration_s=4.0)))
+        srv2 = None
+        try:
+            t.start()
+            time.sleep(1.0)   # client established while srv1 is alone
+            store2, _, _ = open_serving_store(model)
+            srv2 = ServeServer(store2, batch_size=64, max_delay_ms=2.0,
+                               host=srv1.host, port=srv1.port,
+                               takeover=True).start()
+            time.sleep(0.3)
+            srv1.close()      # the abrupt kill: connections torn down
+            t.join()
+        finally:
+            srv1.close()
+            if srv2 is not None:
+                srv2.close()
+        assert rep["err"] == 0, rep
+        assert rep["ok"] > 0, rep
+        assert rep["failovers"] >= 1, rep
+
+
+def test_takeover_driver_sequencing(tmp_path):
+    """tools/takeover.py sequences spawn -> warm -> handoff -> exit —
+    proven with an in-process successor (no second jax process). Also:
+    a handoff SO_REUSEPORT mis-routed to the successor is refused by
+    ready-file ownership, and the incumbent's #health exposed the
+    successor's readiness."""
+    sys.path.insert(0, str(REPO / "tools"))
+    from takeover import run_takeover
+
+    from difacto_tpu.serve import (ServeClient, ServeServer,
+                                   open_serving_store)
+
+    model = _synth_model(tmp_path, "m", vdim=4)
+    with deadline(180):
+        store, _, _ = open_serving_store(model)
+        srv1 = ServeServer(store, takeover=True).start()
+        box = {}
+
+        class _InProc:
+            def poll(self):
+                return None
+
+        def spawn(ready_file):
+            st2, _, _ = open_serving_store(model)
+            srv2 = ServeServer(st2, host=srv1.host, port=srv1.port,
+                               takeover=True).start()
+            srv2.ready_file = ready_file
+            with open(ready_file, "w") as f:
+                f.write(f"{srv2.host} {srv2.port}\n")
+            box["srv2"] = srv2
+            return _InProc()
+
+        try:
+            rep = run_takeover(srv1.host, srv1.port, spawn_fn=spawn,
+                               wait_s=60.0)
+            assert rep["ok"], rep
+            assert rep["incumbent"] != rep["successor"], rep
+            # the incumbent saw the ready file, reported it on #health
+            # (successor_ready), then drained out. The driver can
+            # return while the incumbent's drain is still finishing —
+            # wait for the close before poking at it (and before the
+            # mis-route check below, which needs fresh connections to
+            # reach ONLY the successor).
+            t0 = time.monotonic()
+            while not srv1._closed and time.monotonic() - t0 < 60:
+                time.sleep(0.05)
+            assert srv1._closed
+            assert srv1.successor_ready and srv1.draining
+            assert srv1.health_snapshot()["successor_ready"] is True
+            # mis-routed handoff: the successor refuses by name
+            srv2 = box["srv2"]
+            with ServeClient(srv1.host, srv1.port) as c:
+                resp = c.score_lines(
+                    [b"#handoff " + srv2.ready_file.encode()])[0]
+                assert resp.startswith(b"!err"), resp
+                assert b"successor" in resp
+                assert c.health()["status"] == "ready"
+            assert not srv2.draining
+        finally:
+            srv1.close()
+            if "srv2" in box:
+                box["srv2"].close()
+
+
+def test_continuity_fault_points(tmp_path):
+    """Satellite: the new ``serve.handoff`` and ``reload.warm`` fault
+    points fire, land in faults_fired_total{point,kind}, and fail SAFE:
+    a handoff fault refuses the handoff (no drain), a warm fault aborts
+    the blue/green swap with the old model still serving. A bare
+    reloader (no server) keeps the typed geometry refusal."""
+    from difacto_tpu.obs import REGISTRY
+    from difacto_tpu.serve import (ModelReloader, ServeClient,
+                                   ServeServer, open_serving_store)
+
+    model_a = _synth_model(tmp_path, "ma", vdim=4)
+    model_b = _synth_model(tmp_path, "mb", vdim=8)
+    rows = _synth_rows(8)
+    before_h = REGISTRY.value("faults_fired_total",
+                              point="serve.handoff", kind="err")
+    before_w = REGISTRY.value("faults_fired_total",
+                              point="reload.warm", kind="err")
+    with deadline(180):
+        store, _, _ = open_serving_store(model_a)
+        srv = ServeServer(store, batch_size=8, max_delay_ms=1.0).start()
+        srv.reloader = ModelReloader(srv.executor, model_a, server=srv)
+        try:
+            with ServeClient(srv.host, srv.port) as c:
+                assert c.predict(rows[:1])[0] is not None  # compile blue
+                faultinject.configure("serve.handoff:err@1")
+                resp = c.score_lines([b"#handoff"])[0]
+                assert resp.startswith(b"!err"), resp
+                assert not srv.draining
+                faultinject.configure("reload.warm:err@1")
+                res = c.reload(model_b)
+                assert not res["ok"], res
+                faultinject.configure("")
+                st = c.stats()
+                assert st["reload_failures"] == 1, st
+                assert st["model_generation"] == 1, st
+                assert st["bluegreen_swaps"] == 0, st
+                assert st["swap_state"] == "idle", st
+                # the old model still scores after the aborted swap
+                assert c.predict(rows[:1])[0] is not None
+            # no server attached -> no batcher to retarget: a geometry
+            # change stays a reload failure naming the mismatch
+            bare = ModelReloader(srv.executor, model_a)
+            res = bare.reload(model_b)
+            assert not res["ok"] and "geometry" in res["error"], res
+        finally:
+            faultinject.configure("")
+            srv.close()
+    assert REGISTRY.value("faults_fired_total", point="serve.handoff",
+                          kind="err") > before_h
+    assert REGISTRY.value("faults_fired_total", point="reload.warm",
+                          kind="err") > before_w
+
+
+def test_sigterm_during_bluegreen_warm_drains_cleanly(tmp_path):
+    """Satellite race: SIGTERM while a blue/green warm is in flight —
+    the server drains on the OLD executor and exits 0; the half-warmed
+    green is abandoned, no crash, no hang. The injected ``reload.warm``
+    delay holds the warm window open long enough to land the signal
+    inside it deterministically."""
+    model_a = _synth_model(tmp_path, "ma", vdim=4)
+    model_b = _synth_model(tmp_path, "mb", vdim=8)
+    ready = str(tmp_path / "ready")
+    env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu",
+               DIFACTO_FAULTS="reload.warm:delay_ms=3000@1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "difacto_tpu", "task=serve",
+         f"model_in={model_a}", f"serve_ready_file={ready}",
+         "serve_drain_timeout_s=10", "serve_max_seconds=180"],
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        with deadline(240):
+            while not os.path.exists(ready):
+                time.sleep(0.05)
+                assert proc.poll() is None, proc.communicate()[1][-2000:]
+            host, port = open(ready).read().split()
+            from difacto_tpu.serve import ServeClient
+            with ServeClient(host, int(port)) as c:
+                # compile at least one blue bucket so the warm loop has
+                # work (and the injected delay a place to fire)
+                assert c.predict([_synth_rows(1)[0]])[0] is not None
+
+                def _bg_reload():
+                    try:
+                        with ServeClient(host, int(port)) as c2:
+                            c2.reload(model_b)
+                    except Exception:
+                        pass   # the drain may tear this connection down
+
+                threading.Thread(target=_bg_reload, daemon=True).start()
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < 30:
+                    if c.health().get("swap_state") != "idle":
+                        break
+                    time.sleep(0.05)
+                else:
+                    raise AssertionError("blue/green warm never started")
+            proc.send_signal(signal.SIGTERM)   # mid-warm, by the poll
+            rc = proc.wait(timeout=90)
+        assert rc == 0, proc.communicate()[1][-2000:]
+        err = proc.communicate()[1]
+        assert "blue/green: warming" in err, err[-2000:]
+    finally:
+        if proc.poll() is None:  # pragma: no cover - deadline blew
+            proc.kill()
+            proc.wait()
+
+
 # ------------------------------- family-wide pruning (ISSUE 4 satellite)
 
 def test_ckpt_keep_prunes_whole_family(ckpt_model, rcv1_path, tmp_path):

@@ -220,6 +220,43 @@ def test_prometheus_render_and_flusher(tmp_path):
     assert "lat_seconds" in out.stdout
 
 
+def test_flusher_rotation_caps_file(tmp_path):
+    """Satellite (ISSUE 5): ``max_mb`` rolls the JSONL to ``.1`` before
+    a flush would breach the cap — a weeks-long serve process holds at
+    most ~2x max_mb of metrics log — and obs_report still reads the
+    history through the roll."""
+    reg = Registry(enabled=True)
+    reg.counter("reqs_total", "requests").inc()
+    log_path = str(tmp_path / "m.jsonl")
+    # measure one real snapshot line, then cap at ~2.5 lines per file
+    probe = str(tmp_path / "probe.jsonl")
+    MetricsFlusher(probe, interval_s=999.0, registries=[reg]).flush()
+    cap_mb = (os.path.getsize(probe) * 2.5) / (1 << 20)
+    fl = MetricsFlusher(log_path, interval_s=999.0, registries=[reg],
+                        max_mb=cap_mb)
+    for i in range(12):
+        reg.counter("reqs_total").inc()
+        fl.flush()
+    fl.close()   # never started; close() just final-flushes
+    cap_bytes = cap_mb * (1 << 20)
+    assert os.path.exists(log_path + ".1"), "never rotated"
+    assert os.path.getsize(log_path) <= cap_bytes
+    assert os.path.getsize(log_path + ".1") <= cap_bytes
+    # the reader walks .1 then the live file: newest snapshot wins and
+    # nothing crashes on the roll boundary
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import obs_report
+    snap = obs_report.load_last_snapshot(log_path)
+    assert snap["counters"]["reqs_total"][""] == 13
+    # live file empty right after a roll: history still resolves
+    empty = str(tmp_path / "e.jsonl")
+    os.replace(log_path, empty + ".1")
+    open(empty, "w").close()
+    assert obs_report.load_last_snapshot(empty)[
+        "counters"]["reqs_total"][""] == 13
+
+
 # -------------------------------------------------------- serve #metrics
 
 def test_serve_metrics_endpoint():

@@ -281,6 +281,90 @@ def test_overload_sheds_and_stays_bounded(trained_model, rcv1_path):
     assert snap["shed"] == rep["shed"]
 
 
+def test_parse_endpoints_grammar():
+    """One endpoint-list grammar for client/loadgen/takeover
+    (config.parse_endpoints)."""
+    from difacto_tpu.config import parse_endpoints
+
+    assert parse_endpoints("a:1,b:2") == [("a", 1), ("b", 2)]
+    assert parse_endpoints(" a:1 , b:2 ") == [("a", 1), ("b", 2)]
+    assert parse_endpoints([("h", 3), "i:4"]) == [("h", 3), ("i", 4)]
+    with pytest.raises(ValueError, match="bad endpoint"):
+        parse_endpoints("noport")
+    with pytest.raises(ValueError, match="empty endpoint"):
+        parse_endpoints("")
+
+
+def _free_port() -> int:
+    """A port that was just free — nothing listens on it afterwards, so
+    connecting yields ECONNREFUSED (the dead-replica stand-in)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_client_multi_endpoint_failover(trained_model, rcv1_path):
+    """ISSUE 5 client leg, unit level: a dead first endpoint is skipped
+    at connect; killing the active replica mid-call fails the unanswered
+    tail over to the next one; per-endpoint health tracks ejection."""
+    from difacto_tpu.serve import (ServeClient, ServeServer,
+                                   open_serving_store)
+    rows = fixture_rows(rcv1_path)
+    with deadline(120):
+        store, _, _ = open_serving_store(trained_model["model"])
+        srv1 = ServeServer(store, batch_size=32, max_delay_ms=2.0).start()
+        srv2 = ServeServer(store, batch_size=32, max_delay_ms=2.0).start()
+        dead = _free_port()
+        try:
+            with ServeClient(endpoints=[("127.0.0.1", dead),
+                                        (srv1.host, srv1.port),
+                                        (srv2.host, srv2.port)],
+                             retries=2, eject_after=1,
+                             reprobe_s=30.0) as c:
+                # constructor already failed over past the dead replica
+                assert c.port == srv1.port
+                assert c.failovers >= 1
+                got = c.predict(rows[:5])
+                assert all(g is not None for g in got)
+                eh = c.endpoints_health()
+                assert eh[0]["fails"] >= 1 and eh[0]["ejected"]
+                assert eh[1]["active"] and not eh[1]["ejected"]
+                # kill the active replica: the tail fails over to srv2
+                srv1.close()
+                got = c.predict(rows[:10])
+                assert all(g is not None for g in got)
+                assert c.port == srv2.port
+        finally:
+            srv1.close()
+            srv2.close()
+
+
+def test_client_ejection_and_timed_reprobe(trained_model):
+    """An ejected endpoint comes back after reprobe_s — the first use
+    after the window is the probe, not a permanent blacklist."""
+    from difacto_tpu.serve import (ServeClient, ServeServer,
+                                   open_serving_store)
+    with deadline(60):
+        store, _, _ = open_serving_store(trained_model["model"])
+        srv = ServeServer(store, batch_size=8, max_delay_ms=1.0).start()
+        dead = _free_port()
+        try:
+            with ServeClient(endpoints=[("127.0.0.1", dead),
+                                        (srv.host, srv.port)],
+                             retries=1, eject_after=1,
+                             reprobe_s=0.2) as c:
+                assert c.endpoints_health()[0]["ejected"]
+                time.sleep(0.25)
+                assert not c.endpoints_health()[0]["ejected"]
+                # single endpoint + retries=0 keeps fail-fast semantics
+                with pytest.raises(OSError):
+                    ServeClient("127.0.0.1", dead, retries=0)
+        finally:
+            srv.close()
+
+
 def test_no_serve_threads_leak_overall():
     """Whatever ran before this test, no serve threads may survive."""
     names = [t.name for t in threading.enumerate()

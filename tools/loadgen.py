@@ -16,6 +16,14 @@ Usage:
 Prints one JSON line: offered/achieved QPS, ok/shed/err counts, and
 p50/p95/p99/max response latency (ms). Importable as ``run_loadgen`` —
 bench.py --serve and tests/test_serve.py drive it in-process.
+
+``--endpoints h1:p1,h2:p2`` switches to the FAILOVER driver
+(``run_loadgen_failover``): arrivals follow the same open-loop schedule,
+but rows travel through the multi-endpoint ``ServeClient``
+(serve/client.py) in small pipelined chunks — a killed or draining
+replica shows up as failovers and retried tails, not client errors.
+This is the harness the takeover/blue-green chaos tests point at a
+replica pair to prove "zero client-visible errors".
 """
 
 from __future__ import annotations
@@ -153,10 +161,89 @@ def run_loadgen(host: str, port: int, rows: Sequence[Line], qps: float,
     return out
 
 
+def run_loadgen_failover(endpoints, rows: Sequence[Line], qps: float,
+                         duration_s: float, seed: int = 0,
+                         retries: int = 8, chunk: int = 64,
+                         timeout: float = 30.0) -> dict:
+    """Open-loop schedule over the failover ``ServeClient``: due rows
+    are pipelined in chunks of at most ``chunk``; a dropped replica is
+    absorbed by the client (reconnect / next endpoint / resend tail),
+    so only genuine ``!err`` rows or exhausted budgets count as errors.
+    Latency is measured from each row's SCHEDULED arrival, so queueing
+    behind a failover window is charged honestly."""
+    from difacto_tpu.serve import ServeClient
+    rows = [_to_bytes(r) for r in rows]
+    if not rows:
+        raise ValueError("loadgen needs at least one request row")
+    rng = np.random.RandomState(seed)
+    client = ServeClient(endpoints=endpoints, retries=retries,
+                         backoff_s=0.02, backoff_max_s=0.5,
+                         timeout=timeout)
+    lat_ok: List[float] = []
+    n_ok = n_shed = n_err = sent = 0
+    i = 0
+    t_start = time.monotonic()
+    t_next, t_end = t_start, t_start + duration_s
+    try:
+        while time.monotonic() < t_end:
+            due = []
+            now = time.monotonic()
+            while t_next <= now and t_next < t_end and len(due) < chunk:
+                due.append((rows[i % len(rows)], t_next))
+                i += 1
+                t_next += rng.exponential(1.0 / qps)
+            if not due:
+                time.sleep(min(max(t_next - now, 0.0), 0.01))
+                continue
+            sent += len(due)
+            try:
+                resp = client.score_lines([r for r, _ in due])
+            except (OSError, ConnectionError):
+                n_err += len(due)   # every endpoint's budget exhausted
+                continue
+            done = time.monotonic()
+            for (_, t0), line in zip(due, resp):
+                if line.startswith(b"!shed"):
+                    n_shed += 1
+                elif line.startswith(b"!err"):
+                    n_err += 1
+                else:
+                    n_ok += 1
+                    lat_ok.append(done - t0)
+    finally:
+        failovers = client.failovers
+        endpoints_health = client.endpoints_health()
+        client.close()
+    elapsed = time.monotonic() - t_start
+    out = {
+        "target_qps": qps,
+        "duration_s": round(duration_s, 3),
+        "sent": sent,
+        "offered_qps": round(sent / max(duration_s, 1e-9), 1),
+        "ok": n_ok,
+        "shed": n_shed,
+        "err": n_err,
+        "shed_rate": round(n_shed / max(sent, 1), 4),
+        "achieved_qps": round(n_ok / max(elapsed, 1e-9), 1),
+        "failovers": failovers,
+        "endpoints": endpoints_health,
+    }
+    if lat_ok:
+        lat = np.asarray(lat_ok) * 1e3
+        p50, p95, p99 = np.percentile(lat, [50, 95, 99])
+        out.update(p50_ms=round(float(p50), 3), p95_ms=round(float(p95), 3),
+                   p99_ms=round(float(p99), 3),
+                   max_ms=round(float(lat.max()), 3))
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--host", default="127.0.0.1")
-    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--port", type=int)
+    ap.add_argument("--endpoints", default="",
+                    help="h1:p1,h2:p2 — drive the multi-endpoint "
+                         "failover client instead of one raw socket")
     ap.add_argument("--data", required=True,
                     help="request rows, one per line (e.g. a libsvm file)")
     ap.add_argument("--qps", type=float, default=500.0)
@@ -164,12 +251,25 @@ def main() -> None:
     ap.add_argument("--max-rows", type=int, default=100000,
                     help="cap on distinct rows read from --data")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--retries", type=int, default=8,
+                    help="per-endpoint retry budget (failover mode)")
     args = ap.parse_args()
+    if not args.endpoints and args.port is None:
+        ap.error("pass --port or --endpoints")
     with open(args.data, "rb") as f:
         rows = [l for l in f.read().splitlines() if l.strip()]
     rows = rows[:args.max_rows]
-    print(json.dumps(run_loadgen(args.host, args.port, rows, args.qps,
-                                 args.duration, seed=args.seed)))
+    if args.endpoints:
+        import os
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        print(json.dumps(run_loadgen_failover(
+            args.endpoints, rows, args.qps, args.duration,
+            seed=args.seed, retries=args.retries)))
+    else:
+        print(json.dumps(run_loadgen(args.host, args.port, rows, args.qps,
+                                     args.duration, seed=args.seed)))
 
 
 if __name__ == "__main__":

@@ -32,19 +32,28 @@ STAGE_ORDER = ("parse", "pack", "ring_wait", "transfer", "step")
 
 def load_last_snapshot(path: str) -> dict:
     """Last parseable line of the JSONL log (a torn final line — crash
-    mid-flush — is skipped, the previous flush wins)."""
+    mid-flush — is skipped, the previous flush wins). Reads the rolled
+    file ``<path>.1`` first when present (MetricsFlusher ``max_mb``
+    rotation): snapshots are cumulative, so the newest line across both
+    files — the live file's, unless it is fresh-empty right after a
+    roll — is the run's state."""
+    import os
     last = None
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                last = json.loads(line)
-            except ValueError:
-                continue
+    for p in (path + ".1", path):
+        if not os.path.exists(p):
+            continue
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    last = json.loads(line)
+                except ValueError:
+                    continue
     if last is None:
-        raise SystemExit(f"no parseable JSONL lines in {path}")
+        raise SystemExit(f"no parseable JSONL lines in {path}"
+                         f" (or {path}.1)")
     return last.get("metrics", last)
 
 
