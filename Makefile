@@ -11,7 +11,7 @@ PY ?= python
 METRICS ?= run.metrics.jsonl
 TRACE ?=
 
-.PHONY: test smoke ci chaos obs-report
+.PHONY: test smoke ci chaos fleet-chaos obs-report
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -20,6 +20,12 @@ test:
 # client failover — tests/test_chaos.py and friends)
 chaos:
 	$(PY) -m pytest tests/ -m chaos -q
+
+# fleet suite alone (rolling restart behind the router under load,
+# abort-on-regression legs, router peer retry, shared blacklist —
+# docs/serving.md "Fleet operations")
+fleet-chaos:
+	$(PY) -m pytest tests/ -m chaos -q -k "fleet or router or rolling"
 
 smoke:
 	$(PY) bench.py --device-only --steps 2 --batch-size 128 --uniq 256 --capacity 1024 --vdim 4

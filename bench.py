@@ -333,6 +333,7 @@ def run_serve_bench(args) -> dict:
     server.start()
     drain_s = 0.0
     bluegreen_ms = 0.0
+    warm_parallel_ms = 0.0
     takeover_gap_ms = 0.0
     reload_ms: list = []
     try:
@@ -379,6 +380,10 @@ def run_serve_bench(args) -> dict:
                 res = c.reload(model2)
                 if res.get("ok"):
                     bluegreen_ms = (_time.monotonic() - t0) * 1e3
+                    # the warm-set portion alone, now compiled on a
+                    # thread pool (serve/reload.py warm_workers) — the
+                    # number the parallel-warm satellite moves
+                    warm_parallel_ms = server.reloader.last_warm_ms
         # SO_REUSEPORT takeover gap: bind a successor to the SAME port,
         # drain the incumbent, and measure handoff-start -> first fresh
         # connection answered ready by the successor (the client-visible
@@ -421,6 +426,7 @@ def run_serve_bench(args) -> dict:
         if reload_ms else 0.0,
         "drain_s": round(drain_s, 3),
         "bluegreen_swap_ms": round(bluegreen_ms, 3),
+        "warm_parallel_ms": round(warm_parallel_ms, 3),
         "takeover_gap_ms": round(takeover_gap_ms, 3),
         "p50_ms": rep.get("p50_ms", 0.0),
         "p95_ms": rep.get("p95_ms", 0.0),

@@ -148,6 +148,13 @@ def parse_endpoints(spec) -> List[Tuple[str, int]]:
     return out
 
 
+def format_endpoints(eps) -> str:
+    """Inverse of :func:`parse_endpoints`: ``[(h, p), ...]`` ->
+    ``"h1:p1,h2:p2"`` — the grammar fleet reports and the shared
+    blacklist keys (serve/fleethealth.py) round-trip through."""
+    return ",".join(f"{h}:{int(p)}" for h, p in parse_endpoints(eps))
+
+
 def warn_unknown(remain: KWArgs) -> None:
     """Log unconsumed keys at the end of the config chain (src/main.cc:40-46)."""
     for k, v in remain:

@@ -17,7 +17,13 @@ restarts: a geometry-changing reload runs a blue/green executor swap
 (reload.py), `#handoff` + SO_REUSEPORT hand the port to a successor
 process with zero dropped traffic (server.py, tools/takeover.py), and
 ServeClient fails over across a replica endpoint list (client.py).
-``task=serve`` (__main__.py) is the CLI entry;
+The fleet layer (ISSUE 6) scales continuity from one replica pair to N:
+a health-gated rolling-restart orchestrator replaces replicas one at a
+time and aborts on any `#health` regression (fleet.py, tools/fleet.py),
+a thin router balances rows with power-of-two-choices and retries
+unanswered tails on a peer (router.py), and a shared advisory-locked
+blacklist file propagates one client's endpoint ejection to the whole
+fleet (fleethealth.py). ``task=serve`` (__main__.py) is the CLI entry;
 tools/loadgen.py drives it open-loop; bench.py --serve tracks the
 latency/throughput/resilience trajectory; tests/test_chaos.py proves the
 failure paths under injected faults (utils/faultinject.py).
@@ -33,8 +39,11 @@ from ..utils.manifest import CheckpointCorrupt
 from .batcher import MicroBatcher, ServeStats
 from .client import ServeClient
 from .executor import PredictExecutor, sigmoid
+from .fleet import HealthGate, run_rolling_restart, run_takeover
+from .fleethealth import FleetHealth
 from .model import model_meta, open_serving_store, resolve_model_path
 from .reload import ModelReloader
+from .router import RouterServer
 from .server import ServeServer
 
 log = logging.getLogger("difacto_tpu")
@@ -143,4 +152,6 @@ def run_serve(kwargs: KWArgs) -> KWArgs:
 __all__ = ["ServeParam", "run_serve", "ServeServer", "ServeClient",
            "PredictExecutor", "MicroBatcher", "ServeStats", "sigmoid",
            "model_meta", "open_serving_store", "resolve_model_path",
-           "ModelReloader", "CheckpointCorrupt"]
+           "ModelReloader", "CheckpointCorrupt", "RouterServer",
+           "FleetHealth", "HealthGate", "run_rolling_restart",
+           "run_takeover"]

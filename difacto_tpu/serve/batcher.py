@@ -109,6 +109,14 @@ class ServeStats:
         s = self._qdm_g.labels()
         s.set(max(s.value(), queue_depth))
 
+    def shed_rate(self) -> float:
+        """Lifetime shed fraction — cheap enough for every ``#health``
+        poll (two counter reads), which is where the rolling-restart
+        gate (serve/fleet.py) watches for a shed spike."""
+        n_shed = self._shed_c.value()
+        offered = self._req_c.value() + n_shed
+        return round(n_shed / max(offered, 1), 4)
+
     def record_latency(self, seconds: float) -> None:
         self._resp_c.inc()
         self._lat_h.observe(seconds)

@@ -22,6 +22,14 @@ Resilience contract (the client half of the serve lifecycle):
   (timed re-probe — the first use after the window IS the probe); when
   every endpoint is ejected the least-recently-ejected one is tried
   anyway (a client never deadlocks itself into "no replicas").
+- **shared endpoint health**: pass ``blacklist=`` (a path or a
+  fleethealth.FleetHealth handle) and ejections propagate fleet-wide —
+  this client seeds its ejection windows from the shared file at
+  construction (a blacklisted endpoint is skipped on the FIRST connect,
+  no timeout paid) and on every failover, writes its own ejections
+  down, and clears an entry early when its re-probe succeeds. The
+  router (serve/router.py) reads and writes the same file, so one
+  discovery of a dead replica serves every client.
 - ``!shed`` (queue full, or a draining replica) is **retryable**: the
   server explicitly asked for the row again later, so ``predict`` backs
   off and resends just the shed rows within the same budget.
@@ -52,14 +60,19 @@ def _to_bytes(line: Line) -> bytes:
 
 
 class _Endpoint:
-    """Per-replica health: consecutive failures + ejection window."""
+    """Per-replica health: consecutive failures + ejection window, plus
+    the per-endpoint tallies a rollout chaos run reads back (which
+    replica absorbed the handoff traffic)."""
 
-    __slots__ = ("host", "port", "fails", "down_until")
+    __slots__ = ("host", "port", "fails", "down_until", "rows",
+                 "ejections")
 
     def __init__(self, host: str, port: int):
         self.host, self.port = host, int(port)
         self.fails = 0
         self.down_until = 0.0
+        self.rows = 0         # response lines answered by this endpoint
+        self.ejections = 0    # times the ejection window opened
 
 
 class ServeClient:
@@ -69,7 +82,8 @@ class ServeClient:
                  backoff_max_s: float = 2.0,
                  deadline_s: Optional[float] = None,
                  endpoints=None, eject_after: int = 3,
-                 reprobe_s: float = 5.0):
+                 reprobe_s: float = 5.0, blacklist=None):
+        from .fleethealth import open_blacklist
         if endpoints is not None:
             eps = parse_endpoints(endpoints)
         elif host is not None and port is not None:
@@ -80,6 +94,16 @@ class ServeClient:
         self._cur = 0
         self.eject_after = eject_after
         self.reprobe_s = reprobe_s
+        self.blacklist = open_blacklist(blacklist, down_s=reprobe_s)
+        # seed ejection windows from the fleet's shared discoveries and
+        # start on a replica nobody has marked down — a blacklisted
+        # endpoint is skipped on the FIRST connect, before any timeout
+        self._absorb_blacklist()
+        now = time.monotonic()
+        for k, ep in enumerate(self._eps):
+            if ep.down_until <= now:
+                self._cur = k
+                break
         self.failovers = 0           # times the active endpoint moved
         self.timeout = timeout
         self.retries = retries
@@ -104,13 +128,32 @@ class ServeClient:
         return self._eps[self._cur].port
 
     def endpoints_health(self) -> List[dict]:
-        """Per-endpoint view: consecutive failures + ejection state —
-        what a fleet debugger prints when a replica list degrades."""
+        """Per-endpoint view: rows answered, consecutive failures,
+        ejection count/state — what a fleet debugger (and
+        tools/loadgen.py --endpoints) prints when a replica list
+        degrades: which replica absorbed the traffic, which got
+        ejected."""
         now = time.monotonic()
-        return [{"host": e.host, "port": e.port, "fails": e.fails,
+        return [{"host": e.host, "port": e.port, "rows": e.rows,
+                 "fails": e.fails, "ejections": e.ejections,
                  "ejected": e.down_until > now,
                  "active": i == self._cur}
                 for i, e in enumerate(self._eps)]
+
+    def _absorb_blacklist(self) -> None:
+        """Fold fleet-wide down marks into the local ejection windows —
+        another client's consecutive-failure discovery suppresses the
+        endpoint here without this client ever dialing it."""
+        if self.blacklist is None:
+            return
+        downs = self.blacklist.down_endpoints()
+        if not downs:
+            return
+        now = time.monotonic()
+        for ep in self._eps:
+            rem = downs.get(f"{ep.host}:{ep.port}", 0.0)
+            if rem > 0:
+                ep.down_until = max(ep.down_until, now + rem)
 
     def _deadline(self) -> Optional[float]:
         return (time.monotonic() + self.deadline_s
@@ -131,10 +174,16 @@ class ServeClient:
             delay = min(delay, remaining)
         time.sleep(delay)
 
-    def _note_success(self) -> None:
+    def _note_success(self, rows: int = 0) -> None:
         ep = self._eps[self._cur]
+        was_down = ep.down_until > 0.0
         ep.fails = 0
         ep.down_until = 0.0
+        ep.rows += rows
+        if was_down and self.blacklist is not None:
+            # the re-probe succeeded: clear the entry fleet-wide early
+            # instead of every client waiting out its own window
+            self.blacklist.mark_up(ep.host, ep.port)
 
     def _failover(self, attempts: dict, deadline: Optional[float],
                   err: BaseException) -> None:
@@ -149,7 +198,14 @@ class ServeClient:
         ep = self._eps[i]
         ep.fails += 1
         if ep.fails >= self.eject_after:
+            if ep.down_until <= time.monotonic():
+                ep.ejections += 1
+                if self.blacklist is not None:
+                    # first discovery: every other client/router reading
+                    # the shared file now skips this endpoint
+                    self.blacklist.mark_down(ep.host, ep.port)
             ep.down_until = time.monotonic() + self.reprobe_s
+        self._absorb_blacklist()   # learn the fleet's discoveries too
         attempts[i] = attempts.get(i, 0) + 1
         n = len(self._eps)
         order = [(i + k) % n for k in range(1, n + 1)]  # others first
@@ -227,12 +283,14 @@ class ServeClient:
                             "server closed the connection")
                     out.append(resp.rstrip(b"\n"))
                     answered += 1
-                self._note_success()
+                self._note_success(answered)
                 return out
             except (OSError, ConnectionError) as e:
                 # in-order responses: rows already appended to ``out``
-                # are answered for good; only the tail resends
+                # are answered for good (credited to the endpoint that
+                # answered them); only the tail resends
                 pending = pending[answered:]
+                self._eps[self._cur].rows += answered
                 self._drop_conn()
                 self._failover(attempts, deadline, e)
         return out

@@ -1,0 +1,375 @@
+"""Fleet orchestration: health-gated rolling restarts over N replicas.
+
+tools/takeover.py proved the single-replica primitive — spawn a
+successor on the shared SO_REUSEPORT port, warm it, ``#handoff``, drain
+the incumbent. This module generalizes it to the fleet the reference
+runs (PAPER.md: a scheduler supervising many servers/workers): restart N
+replicas **one at a time behind a health gate**, so a model rollout (or
+a binary upgrade) never takes more than one replica's capacity out of
+rotation, and a rollout that makes things worse stops *before* it
+spreads.
+
+The sequencing per replica is exactly the takeover driver's — hold a
+connection to the incumbent while it is the only listener on its port,
+spawn the successor (``serve_takeover=1``, ready-file signaled), send
+``#handoff <ready_file>`` on the held connection, poll fresh
+connections until the successor's ``server_id`` answers ready. What the
+fleet layer adds is the **gate** around every handoff:
+
+- ``#health`` of EVERY replica is polled before a handoff starts and
+  after it completes;
+- the rollout **aborts, leaving the incumbent serving**, on any health
+  regression: a replica not ``ready``, queue depth past
+  ``queue_frac`` of its cap, shed rate spiking past the baseline
+  captured at rollout start, or the successor's ready file never
+  appearing within ``wait_s``;
+- an abort before the ``#handoff`` line is sent costs nothing — the
+  incumbent never stopped serving; an abort after replica *i*'s handoff
+  leaves replicas ``0..i`` on the new generation and ``i+1..N-1``
+  untouched (the report says exactly which).
+
+``fleet.handoff`` is a chaos injection point fired at each replica's
+handoff step (utils/faultinject.py): ``err`` models a botched rotation
+and must abort the rollout with the incumbent intact —
+tests/test_chaos.py asserts exactly that.
+
+CLI: ``tools/fleet.py roll`` (and ``tools/takeover.py`` remains the
+single-replica wrapper). In-process tests drive ``run_rolling_restart``
+with a ``spawn_fn`` instead of subprocess successors.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..config import parse_endpoints
+from ..utils import faultinject
+
+log = logging.getLogger("difacto_tpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class EndpointRpc:
+    """One newline-JSON control channel over a held TCP connection.
+
+    Holding matters under SO_REUSEPORT: a FRESH connection hashes to any
+    listener on the port, but an ESTABLISHED one stays with its owner —
+    so a ``#handoff`` sent on a connection opened while the incumbent was
+    the only listener provably reaches the incumbent."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self.rfile = self.sock.makefile("rb")
+
+    def call(self, line: str) -> dict:
+        self.sock.sendall(line.encode() + b"\n")
+        resp = self.rfile.readline()
+        if not resp:
+            raise ConnectionError("connection closed")
+        if resp.startswith(b"!err"):
+            raise ConnectionError(resp.rstrip(b"\n").decode())
+        return json.loads(resp)
+
+    def close(self) -> None:
+        try:
+            self.rfile.close()
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def fresh_health(host: str, port: int, timeout: float = 5.0) -> dict:
+    """#health over a throwaway connection — what a load balancer (or
+    the gate below) polls; under a takeover it answers from whichever
+    replica currently owns fresh connections."""
+    rpc = EndpointRpc(host, port, timeout=timeout)
+    try:
+        return rpc.call("#health")
+    finally:
+        rpc.close()
+
+
+class HealthGate:
+    """The regression detector around every handoff.
+
+    One instance spans a rollout: the first sighting of each endpoint
+    records its baseline shed rate, so "spike" means *worse than when
+    this rollout started*, not worse than zero (a fleet already shedding
+    at its admission bound is not a reason to freeze rollouts — getting
+    MORE shed during one is)."""
+
+    def __init__(self, queue_frac: float = 0.9, shed_spike: float = 0.25,
+                 timeout: float = 5.0):
+        self.queue_frac = queue_frac
+        self.shed_spike = shed_spike
+        self.timeout = timeout
+        self._baseline_shed: Dict[str, float] = {}
+
+    def check_one(self, host: str, port: int) -> Optional[str]:
+        """None when healthy, else the human-readable regression."""
+        ep = f"{host}:{port}"
+        try:
+            h = fresh_health(host, port, timeout=self.timeout)
+        except (OSError, ConnectionError, ValueError) as e:
+            return f"{ep} unreachable: {e}"
+        if h.get("status") != "ready":
+            return f"{ep} not ready (status={h.get('status')!r})"
+        depth, cap = h.get("queue_depth", 0), h.get("queue_cap", 0)
+        if cap and depth > self.queue_frac * cap:
+            return (f"{ep} queue depth blowup: {depth}/{cap} rows "
+                    f"(gate at {self.queue_frac:.0%})")
+        shed = float(h.get("shed_rate", 0.0))
+        base = self._baseline_shed.setdefault(ep, shed)
+        if shed > base + self.shed_spike:
+            return (f"{ep} shed-rate spike: {shed:.4f} vs baseline "
+                    f"{base:.4f} (gate at +{self.shed_spike})")
+        return None
+
+    def check(self, endpoints: List[Tuple[str, int]]) -> Optional[str]:
+        """First regression across the fleet, or None."""
+        for host, port in endpoints:
+            reason = self.check_one(host, port)
+            if reason is not None:
+                return reason
+        return None
+
+    def check_settled(self, endpoints: List[Tuple[str, int]],
+                      wait_s: float = 10.0,
+                      poll_s: float = 0.2) -> Optional[str]:
+        """``check`` with a settle window: a handoff's transient blip —
+        the incumbent's dying listener resetting a probe that raced into
+        its backlog, a queue momentarily deep while the tail fails over
+        — is not a regression; STAYING unhealthy for ``wait_s`` is. The
+        rollout gates on this, so it halts on real damage without
+        flapping on the rotation it is itself causing."""
+        t0 = time.monotonic()
+        reason = self.check(endpoints)
+        while reason is not None and time.monotonic() - t0 < wait_s:
+            time.sleep(poll_s)
+            reason = self.check(endpoints)
+        return reason
+
+
+def spawn_successor(model: str, port: int, ready_file: str, extra=(),
+                    host: str = "127.0.0.1") -> "subprocess.Popen":
+    """Default successor: a fresh task=serve process on the shared port
+    (serve_takeover=1 so the kernel accepts the second binding). Its
+    output goes to ``<ready_file>.log`` — NOT the driver's inherited
+    pipes, which a parent capturing the driver's output would otherwise
+    wait on for the whole life of the successor."""
+    args = [sys.executable, "-m", "difacto_tpu", "task=serve",
+            f"model_in={model}", f"serve_host={host}",
+            f"serve_port={port}", "serve_takeover=1",
+            f"serve_ready_file={ready_file}", *extra]
+    logf = open(ready_file + ".log", "ab")
+    try:
+        return subprocess.Popen(args, cwd=REPO, stdin=subprocess.DEVNULL,
+                                stdout=logf, stderr=logf,
+                                start_new_session=True)
+    finally:
+        logf.close()   # the child holds its own descriptor
+
+
+def _wait_ready_file(ready_file: str, proc, wait_s: float,
+                     poll_s: float) -> float:
+    """Block until the successor writes its ready file; returns the warm
+    seconds. Raises on successor exit or timeout — BEFORE any handoff,
+    so the incumbent is untouched."""
+    t0 = time.monotonic()
+    while not os.path.exists(ready_file):
+        if proc is not None and getattr(proc, "poll", None) \
+                and proc.poll() is not None:
+            raise RuntimeError(
+                f"successor exited rc={proc.poll()} before ready")
+        if time.monotonic() - t0 > wait_s:
+            raise TimeoutError(
+                f"successor not ready after {wait_s:.0f}s")
+        time.sleep(poll_s)
+    return time.monotonic() - t0
+
+
+def _wait_takeover(host: str, port: int, incumbent_id: str,
+                   wait_s: float, poll_s: float) -> dict:
+    """Poll fresh connections until the successor answers ready."""
+    t0 = time.monotonic()
+    while True:
+        try:
+            h = fresh_health(host, port)
+            if h.get("server_id") != incumbent_id \
+                    and h.get("status") == "ready":
+                return h
+        except (OSError, ConnectionError, ValueError):
+            pass
+        if time.monotonic() - t0 > wait_s:
+            raise TimeoutError(
+                "takeover never completed: fresh connections still "
+                "reach the incumbent (or nothing)")
+        time.sleep(poll_s)
+
+
+# ------------------------------------------------- single replica (PR 5)
+
+def run_takeover(host: str, port: int, model: str = "", extra=(),
+                 spawn_fn=None, wait_s: float = 180.0,
+                 poll_s: float = 0.05) -> dict:
+    """Sequence ONE takeover; returns the report dict. ``spawn_fn``
+    (ready_file -> handle with .poll(), or None) overrides the
+    subprocess successor for in-process tests. This is the primitive the
+    rolling restart below gates and repeats."""
+    # 1. hold a connection to the incumbent while it is the only
+    #    listener — #handoff later rides this connection, immune to
+    #    SO_REUSEPORT's fresh-connection hashing
+    incumbent = EndpointRpc(host, port)
+    try:
+        h0 = incumbent.call("#health")
+        if not h0.get("takeover"):
+            raise SystemExit(
+                "incumbent is not running serve_takeover=1 — restart it "
+                "once with the knob before zero-downtime handoffs work")
+        incumbent_id = h0["server_id"]
+
+        # 2. spawn the successor; it loads + warms, binds the shared
+        #    port, then writes its ready file
+        fd, ready_file = tempfile.mkstemp(suffix=".ready")
+        os.close(fd)
+        os.unlink(ready_file)   # the successor's write IS the signal
+        proc = (spawn_fn(ready_file) if spawn_fn is not None
+                else spawn_successor(model, port, ready_file, extra,
+                                     host=host))
+        warm_s = _wait_ready_file(ready_file, proc, wait_s, poll_s)
+
+        # 3. handoff: the incumbent confirms the ready file, drains and
+        #    exits; its established connections finish first
+        t1 = time.monotonic()
+        res = incumbent.call(f"#handoff {ready_file}")
+
+        # 4. fresh connections answer from the successor, ready
+        h = _wait_takeover(host, port, incumbent_id, wait_s, poll_s)
+        out = {"ok": True, "incumbent": incumbent_id,
+               "successor": h["server_id"],
+               "model_generation": h.get("model_generation"),
+               "warm_s": round(warm_s, 3), "handoff": res,
+               "takeover_gap_ms":
+                   round((time.monotonic() - t1) * 1e3, 1)}
+        if spawn_fn is None:
+            out["successor_log"] = ready_file + ".log"
+        return out
+    finally:
+        incumbent.close()
+
+
+# --------------------------------------------------- rolling restart (N)
+
+def run_rolling_restart(
+        endpoints, model: str = "", extra=(),
+        spawn_fn: Optional[Callable] = None,
+        wait_s: float = 180.0, poll_s: float = 0.05,
+        gate: Optional[HealthGate] = None,
+        gate_wait_s: float = 10.0) -> dict:
+    """Health-gated rolling restart: replace every replica in
+    ``endpoints`` (``"h1:p1,h2:p2"`` or pairs), one at a time, each
+    behind a fleet-wide ``#health`` gate. ``spawn_fn(i, host, port,
+    ready_file)`` overrides the subprocess successor for in-process
+    tests.
+
+    Returns ``{"ok": True, "replicas": [per-replica reports]}`` on a
+    complete rollout, or ``{"ok": False, "aborted_at": i, "endpoint":
+    "h:p", "reason": ..., "completed": [...]}`` — with replica *i*'s
+    incumbent still serving — on the first regression."""
+    eps = parse_endpoints(endpoints)
+    gate = gate if gate is not None else HealthGate()
+    completed: List[dict] = []
+
+    def abort(i: int, reason: str) -> dict:
+        host, port = eps[i]
+        log.warning("rolling restart ABORTED at replica %d (%s:%d): %s",
+                    i, host, port, reason)
+        return {"ok": False, "aborted_at": i,
+                "endpoint": f"{host}:{port}", "reason": reason,
+                "completed": completed}
+
+    for i, (host, port) in enumerate(eps):
+        # pre-handoff gate: the WHOLE fleet must be healthy before this
+        # replica gives up its port — a rollout never compounds an
+        # outage already in progress (settled: the previous handoff's
+        # transient blip must not masquerade as one)
+        reason = gate.check_settled(eps, wait_s=gate_wait_s)
+        if reason is not None:
+            return abort(i, f"pre-handoff health gate: {reason}")
+        # chaos point: an injected err here models a botched rotation
+        # (scheduler bug, mis-addressed handoff) — the rollout must stop
+        # with the incumbent serving, and the fire is counted in
+        # faults_fired_total{point="fleet.handoff"}
+        try:
+            faultinject.act_default(faultinject.fire("fleet.handoff"))
+        except faultinject.FaultInjected as e:
+            return abort(i, f"injected fleet.handoff fault: {e}")
+        try:
+            incumbent = EndpointRpc(host, port)
+        except OSError as e:
+            return abort(i, f"cannot reach incumbent: {e}")
+        try:
+            h0 = incumbent.call("#health")
+            if not h0.get("takeover"):
+                return abort(i, "incumbent not running serve_takeover=1")
+            incumbent_id = h0["server_id"]
+            fd, ready_file = tempfile.mkstemp(suffix=".ready")
+            os.close(fd)
+            os.unlink(ready_file)
+            proc = (spawn_fn(i, host, port, ready_file)
+                    if spawn_fn is not None
+                    else spawn_successor(model, port, ready_file, extra,
+                                         host=host))
+            try:
+                warm_s = _wait_ready_file(ready_file, proc, wait_s,
+                                          poll_s)
+            except (RuntimeError, TimeoutError) as e:
+                # the successor never made it: nothing was handed off,
+                # the incumbent is still serving — stop the rollout and
+                # reap the half-up successor
+                if proc is not None and hasattr(proc, "terminate"):
+                    try:
+                        proc.terminate()
+                    except OSError:  # pragma: no cover
+                        pass
+                return abort(i, f"successor ready-file: {e}")
+            res = incumbent.call(f"#handoff {ready_file}")
+            try:
+                h = _wait_takeover(host, port, incumbent_id, wait_s,
+                                   poll_s)
+            except TimeoutError as e:
+                return abort(i, str(e))
+        except (OSError, ConnectionError, ValueError) as e:
+            return abort(i, f"handoff failed: {e}")
+        finally:
+            incumbent.close()
+        report = {"endpoint": f"{host}:{port}",
+                  "incumbent": incumbent_id,
+                  "successor": h["server_id"],
+                  "model_generation": h.get("model_generation"),
+                  "warm_s": round(warm_s, 3), "handoff": res}
+        if spawn_fn is None:
+            report["successor_log"] = ready_file + ".log"
+        completed.append(report)
+        # post-handoff gate: the successor (and the rest of the fleet)
+        # must be healthy before the next incumbent gives up its port
+        reason = gate.check_settled(eps, wait_s=gate_wait_s)
+        if reason is not None:
+            return abort(min(i + 1, len(eps) - 1),
+                         f"post-handoff health gate after "
+                         f"{host}:{port}: {reason}")
+        log.info("rolling restart: replica %d/%d (%s:%d) -> %s "
+                 "(warm %.1fs)", i + 1, len(eps), host, port,
+                 h["server_id"], warm_s)
+    return {"ok": True, "replicas": completed}

@@ -35,6 +35,14 @@ executor, recompiled buckets, and a dropped listening socket. The
   and ``serve_bluegreen_swaps_total`` counts the swaps; ``reload.warm``
   is a chaos injection point inside the warm loop
   (utils/faultinject.py).
+- the warm-set pre-compilation runs on a small **thread pool**
+  (``warm_workers``, default 4): bucket compiles are independent XLA
+  compilations that release the GIL, so a live executor with many
+  recorded buckets no longer stretches the swap window by compiling
+  them one at a time. The swap itself stays atomic and any worker
+  failure aborts the whole swap with blue serving; ``last_warm_ms``
+  records the wall-clock warm cost (``bench.py --serve`` emits it as
+  ``serve.warm_parallel_ms``).
 """
 
 from __future__ import annotations
@@ -51,7 +59,7 @@ log = logging.getLogger("difacto_tpu")
 
 class ModelReloader:
     def __init__(self, executor, model_uri: str, poll_s: float = 0.0,
-                 kwargs=(), server=None):
+                 kwargs=(), server=None, warm_workers: int = 4):
         # server=None (bench/unit use): same-geometry swaps only — there
         # is no batcher whose executor reference a blue/green swap could
         # retarget, so a geometry change stays a reload failure
@@ -60,9 +68,11 @@ class ModelReloader:
         self.model_uri = model_uri
         self.poll_s = poll_s
         self._kwargs = list(kwargs)
+        self.warm_workers = warm_workers
         self.reloads = 0
         self.reload_failures = 0
         self.bluegreen_swaps = 0
+        self.last_warm_ms = 0.0              # wall cost of the last warm
         self.swap_state = "idle"             # idle | warming | swapping
         self._reload_mu = threading.Lock()   # serialize concurrent reloads
         self._stop = threading.Event()
@@ -176,21 +186,43 @@ class ModelReloader:
         blue on the batcher thread the whole time. Any failure (corrupt
         warm, injected ``reload.warm`` fault) propagates to the reload
         failure path: green is dropped, blue keeps serving."""
+        from concurrent.futures import ThreadPoolExecutor
+
         from .executor import PredictExecutor
         self.swap_state = "warming"
         try:
             caps, keys = blue.warm_set()
-            log.info("blue/green: warming %d buckets for geometry "
-                     "(V_dim=%d, hash_capacity=%d)", len(keys),
-                     store.param.V_dim, store.param.hash_capacity)
+            workers = max(1, min(self.warm_workers, len(keys) or 1))
+            log.info("blue/green: warming %d buckets on %d threads for "
+                     "geometry (V_dim=%d, hash_capacity=%d)", len(keys),
+                     workers, store.param.V_dim,
+                     store.param.hash_capacity)
             green = PredictExecutor(store)
             green.seed_caps(caps)
-            for key in keys:
+
+            def _warm_one(key):
                 # chaos point: err aborts the swap (blue keeps serving),
-                # delay_ms stretches the warm window (the drain-vs-reload
-                # race tests live here)
+                # delay_ms stretches the warm window (the drain-vs-
+                # reload race tests live here)
                 faultinject.fire("reload.warm")
                 green.warm_bucket(key)
+
+            t0 = time.monotonic()
+            if workers == 1:
+                for key in keys:
+                    _warm_one(key)
+            else:
+                # independent XLA compilations release the GIL, so the
+                # warm-set compiles overlap instead of queueing — the
+                # swap window shrinks with the pool. Any worker failure
+                # propagates out of the result iteration and aborts the
+                # swap before the commit point below.
+                with ThreadPoolExecutor(
+                        max_workers=workers,
+                        thread_name_prefix="bluegreen-warm") as pool:
+                    for _ in pool.map(_warm_one, keys):
+                        pass
+            self.last_warm_ms = (time.monotonic() - t0) * 1e3
             self.swap_state = "swapping"
             green.generation = blue.generation + 1
             self._server.swap_executor(green)
@@ -209,4 +241,5 @@ class ModelReloader:
         return {"reloads": self.reloads,
                 "reload_failures": self.reload_failures,
                 "bluegreen_swaps": self.bluegreen_swaps,
+                "last_warm_ms": round(self.last_warm_ms, 3),
                 "swap_state": self.swap_state}

@@ -1,0 +1,564 @@
+"""Thin routing tier: one address in front of a replica fleet.
+
+PR 5's client-side failover works, but it scales per CLIENT: every
+client holds the replica list, discovers dead replicas itself, and
+balances only by accident (whichever endpoint it happens to sit on).
+The router centralizes that: clients speak the exact same
+libsvm/control wire protocol to ONE address, and the router
+
+- **balances** rows across replicas with power-of-two-choices over live
+  per-endpoint stats — two random live backends, send to the one with
+  the lower (in-flight, recent-latency-EWMA) score. P2C is the standard
+  load-balancing result: it gets within a constant of least-loaded
+  while sampling only two queues, and never herds onto one backend the
+  way stale least-loaded does;
+- **retries the unanswered tail on a peer** exactly like
+  ``ServeClient._failover``: backend responses are in request order, so
+  a dropped backend connection splits the chunk at the exact answered
+  boundary and only the tail is resent — to a DIFFERENT replica,
+  immediately. Per-forward retry budgets exhausted across every backend
+  degrade to explicit ``!shed`` backpressure (retryable), never a hang;
+- **absorbs drain windows**: a replica mid-rotation answers ``!shed
+  draining`` over a perfectly healthy connection, so connection-level
+  failover alone would keep feeding it for the whole drain. The router
+  reads the signal: the draining backend is side-stepped for a short
+  window and the shed rows get ONE re-forward to a peer — a rolling
+  restart behind the router costs clients neither errors nor sheds;
+- **shares endpoint health**: ``eject_after`` consecutive failures
+  eject a backend for ``reprobe_s`` (timed re-probe), and the ejection
+  is written through the shared blacklist file (fleethealth.py) so
+  every other router/client skips the endpoint without dialing it;
+- serves **aggregated control lines** for the whole fleet: ``#health``
+  (fleet-wide status + per-replica payloads), ``#stats`` (router
+  counters + per-backend balance state + summed replica counters),
+  ``#metrics`` (Prometheus text of the router registry, per-endpoint
+  labeled).
+
+Ordering contract: per client connection, responses come back in
+request order — data rows are forwarded in arrival-order chunks (a
+chunk closes at ``chunk`` rows, at a control line, or when the reader
+has nothing more buffered), and control replies are emitted in line
+with the rows around them.
+
+``router.forward`` is a chaos injection point in the forward path
+(utils/faultinject.py): ``err``/``close`` model a backend failing
+mid-chunk and must surface as a peer retry, not a client error.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import random
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..config import parse_endpoints
+from ..utils import faultinject
+from .fleethealth import open_blacklist
+
+log = logging.getLogger("difacto_tpu")
+
+
+class _Backend:
+    """Shared balance/health state for one replica endpoint (the
+    connections themselves are per client handler — two client
+    connections never interleave on one backend socket)."""
+
+    __slots__ = ("host", "port", "in_flight", "ewma_ms", "fails",
+                 "down_until", "rows", "ejections")
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, int(port)
+        self.in_flight = 0
+        self.ewma_ms = 0.0      # recent per-row latency, milliseconds
+        self.fails = 0          # consecutive failures
+        self.down_until = 0.0   # monotonic ejection deadline
+        self.rows = 0           # rows answered by this backend
+        self.ejections = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+class RouterServer:
+    def __init__(self, endpoints, host: str = "127.0.0.1", port: int = 0,
+                 chunk: int = 64, retries: int = 2, eject_after: int = 3,
+                 reprobe_s: float = 5.0, blacklist=None,
+                 timeout: float = 30.0, probe_timeout: float = 2.0,
+                 drain_eject_s: float = 1.0):
+        from ..obs import Registry
+        self._backends = [_Backend(h, p)
+                          for h, p in parse_endpoints(endpoints)]
+        self.chunk = chunk
+        self.retries = retries
+        self.eject_after = eject_after
+        self.reprobe_s = reprobe_s
+        self.timeout = timeout
+        self.probe_timeout = probe_timeout
+        self.drain_eject_s = drain_eject_s
+        self.blacklist = open_blacklist(blacklist, down_s=reprobe_s)
+        self._rng = random.Random(0x20072)
+        self.obs = Registry(enabled=True)
+        self._rows_c = self.obs.counter(
+            "router_rows_forwarded_total",
+            "rows answered through the router, per backend endpoint")
+        self._retry_c = self.obs.counter(
+            "router_retries_total",
+            "chunk tails retried on a peer after a backend failure")
+        self._shed_c = self.obs.counter(
+            "router_shed_total",
+            "rows answered !shed because no backend was available")
+        self._err_c = self.obs.counter(
+            "router_errors_total", "rows rejected at the router")
+        self._mu = threading.Lock()      # backend stats
+        self._sock = socket.create_server((host, port))
+        self._sock.settimeout(0.25)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._alive = False
+        self._closed = False
+        self._done = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._conn_threads: list = []
+        self._cmu = threading.Lock()     # connection bookkeeping
+
+    # ---------------------------------------------------------- control
+    def start(self) -> "RouterServer":
+        self._alive = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="router-accept", daemon=True)
+        self._accept_thread.start()
+        log.info("routing %s:%d -> %s", self.host, self.port,
+                 ",".join(b.key for b in self._backends))
+        return self
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        self._done.wait(timeout)
+
+    def close(self) -> None:
+        with self._cmu:
+            if self._closed:
+                return
+            self._closed = True
+        self._alive = False
+        self._done.set()
+        try:
+            self._sock.close()
+        except OSError:  # pragma: no cover
+            pass
+        if self._accept_thread is not None:
+            self._accept_thread.join()
+            self._accept_thread = None
+        with self._cmu:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        for t in self._conn_threads:
+            t.join()
+        self._conn_threads.clear()
+
+    # ------------------------------------------------------- accept loop
+    def _accept_loop(self) -> None:
+        while self._alive:
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.settimeout(None)
+            try:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:  # pragma: no cover
+                pass
+            with self._cmu:
+                self._conns.add(conn)
+                self._conn_threads = [t for t in self._conn_threads
+                                      if t.is_alive()]
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 name="router-conn", daemon=True)
+            t.start()
+            with self._cmu:
+                self._conn_threads.append(t)
+
+    # ---------------------------------------------------- client handler
+    def _handle(self, conn: socket.socket) -> None:
+        """Order-preserving per-connection loop: a reader thread feeds a
+        queue; this thread folds consecutive data rows into chunks,
+        forwards them, and interleaves control replies in arrival
+        order."""
+        q: "queue.Queue" = queue.Queue()
+
+        def reader() -> None:
+            try:
+                rfile = conn.makefile("rb")
+                for line in rfile:
+                    line = line.strip()
+                    if line:
+                        q.put(line)
+            except (OSError, ValueError):
+                pass
+            finally:
+                q.put(None)
+
+        rt = threading.Thread(target=reader, name="router-conn-reader",
+                              daemon=True)
+        rt.start()
+        pool: Dict[int, Tuple[socket.socket, object]] = {}
+        try:
+            eof = False
+            while not eof:
+                item = q.get()
+                if item is None:
+                    break
+                if item.startswith(b"#"):
+                    conn.sendall(self._control(item))
+                    continue
+                # fold the contiguous data-row run the reader has already
+                # buffered (bounded by chunk) into one backend forward
+                rows = [item]
+                carry = None
+                while len(rows) < self.chunk:
+                    try:
+                        nxt = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        eof = True
+                        break
+                    if nxt.startswith(b"#"):
+                        carry = nxt
+                        break
+                    rows.append(nxt)
+                conn.sendall(b"".join(self._forward(rows, pool)))
+                if carry is not None:
+                    conn.sendall(self._control(carry))
+        except OSError:   # client went away mid-reply
+            pass
+        finally:
+            for s, rf in pool.values():
+                try:
+                    rf.close()
+                    s.close()
+                except OSError:
+                    pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._cmu:
+                self._conns.discard(conn)
+            rt.join()
+
+    # -------------------------------------------------------- balancing
+    def _refresh_blacklist(self) -> None:
+        """Fold fleet-wide down marks into the local ejection windows, so
+        an ejection ANY client recorded suppresses the backend here too."""
+        if self.blacklist is None:
+            return
+        downs = self.blacklist.down_endpoints()
+        if not downs:
+            return
+        now = time.monotonic()
+        with self._mu:
+            for b in self._backends:
+                rem = downs.get(b.key, 0.0)
+                if rem > 0:
+                    b.down_until = max(b.down_until, now + rem)
+
+    def _pick(self, attempts: Dict[int, int]) -> Optional[int]:
+        """Power-of-two-choices over live backends still inside this
+        forward's retry budget; all-ejected falls back to the least-
+        recently-ejected (the router never deadlocks itself into "no
+        replicas" while one might answer). None = budget exhausted."""
+        self._refresh_blacklist()
+        cands = [i for i in range(len(self._backends))
+                 if attempts.get(i, 0) <= self.retries]
+        if not cands:
+            return None
+        now = time.monotonic()
+        with self._mu:
+            live = [i for i in cands
+                    if self._backends[i].down_until <= now]
+            if not live:
+                return min(cands,
+                           key=lambda i: self._backends[i].down_until)
+            if len(live) == 1:
+                return live[0]
+            a, b = self._rng.sample(live, 2)
+            ba, bb = self._backends[a], self._backends[b]
+            return a if (ba.in_flight, ba.ewma_ms) <= \
+                (bb.in_flight, bb.ewma_ms) else b
+
+    def _conn(self, pool: dict, i: int):
+        got = pool.get(i)
+        if got is not None:
+            return got
+        b = self._backends[i]
+        s = socket.create_connection((b.host, b.port),
+                                     timeout=self.timeout)
+        try:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:  # pragma: no cover
+            pass
+        pool[i] = (s, s.makefile("rb"))
+        return pool[i]
+
+    def _drop(self, pool: dict, i: int) -> None:
+        got = pool.pop(i, None)
+        if got is not None:
+            try:
+                got[1].close()
+                got[0].close()
+            except OSError:
+                pass
+
+    def _note_success(self, i: int, rows: int, dt_s: float) -> None:
+        b = self._backends[i]
+        with self._mu:
+            was_down = b.down_until > 0.0 or b.fails >= self.eject_after
+            b.fails = 0
+            b.down_until = 0.0
+            b.rows += rows
+            per_row_ms = dt_s * 1e3 / max(rows, 1)
+            b.ewma_ms = (per_row_ms if b.ewma_ms == 0.0
+                         else 0.8 * b.ewma_ms + 0.2 * per_row_ms)
+        self._rows_c.labels(endpoint=b.key).inc(rows)
+        if was_down and self.blacklist is not None:
+            self.blacklist.mark_up(b.host, b.port)
+
+    def _note_failure(self, i: int, attempts: Dict[int, int],
+                      err: BaseException) -> None:
+        b = self._backends[i]
+        attempts[i] = attempts.get(i, 0) + 1
+        self._retry_c.inc()
+        ejected = False
+        with self._mu:
+            b.fails += 1
+            if b.fails >= self.eject_after:
+                b.down_until = time.monotonic() + self.reprobe_s
+                b.ejections += 1
+                ejected = True
+        if ejected:
+            log.warning("router: ejecting backend %s for %.1fs (%s)",
+                        b.key, self.reprobe_s, err)
+            if self.blacklist is not None:
+                self.blacklist.mark_down(b.host, b.port)
+
+    def _note_draining(self, i: int) -> None:
+        """The backend said ``!shed draining``: it is mid-rotation, not
+        dead — side-step it briefly (no blacklist write, no ejection
+        count; its successor inherits the endpoint within seconds)."""
+        b = self._backends[i]
+        with self._mu:
+            b.down_until = max(b.down_until,
+                               time.monotonic() + self.drain_eject_s)
+
+    def _retry_shed(self, rows: List[bytes], out: List[bytes],
+                    pool: dict) -> List[bytes]:
+        """One re-forward of the rows a backend shed: under a rolling
+        restart the shed came from a draining replica (now side-stepped
+        by _note_draining), so the peer pass usually converts the whole
+        drain window into ordinary answers. Positions are exact — one
+        response line per row — so the splice preserves ordering."""
+        idx = [k for k, line in enumerate(out)
+               if line.startswith(b"!shed")]
+        if not idx:
+            return out
+        sub = self._forward([rows[k] for k in idx], pool,
+                            _retry_shed=False)
+        for k, line in zip(idx, sub):
+            out[k] = line
+        return out
+
+    # ---------------------------------------------------------- forward
+    def _forward(self, rows: List[bytes], pool: dict,
+                 _retry_shed: bool = True) -> List[bytes]:
+        """Forward one chunk; returns one newline-terminated response
+        line per row, in order. Backend failures resend the unanswered
+        tail on a peer; exhausting every backend's budget answers the
+        remainder ``!shed`` (retryable backpressure — the fleet may be
+        mid-rotation, the rows are not wrong)."""
+        pending = [r + b"\n" for r in rows]
+        out: List[bytes] = []
+        attempts: Dict[int, int] = {}
+        while pending:
+            i = self._pick(attempts)
+            if i is None:
+                self._shed_c.inc(len(pending))
+                out.extend([b"!shed router: no backend available\n"]
+                           * len(pending))
+                return out
+            answered = 0
+            b = self._backends[i]
+            n = len(pending)
+            with self._mu:
+                b.in_flight += n
+            try:
+                # chaos point: ``close`` tears this backend connection
+                # down mid-chunk, ``err`` raises — both must surface as
+                # a tail retry on a peer, never a client-visible error
+                kind = faultinject.fire("router.forward")
+                if kind == "close":
+                    self._drop(pool, i)
+                    raise ConnectionError(
+                        "injected router.forward close")
+                faultinject.act_default(kind)
+                s, rf = self._conn(pool, i)
+                t0 = time.monotonic()
+                s.sendall(b"".join(pending))
+                saw_draining = False
+                for _ in range(len(pending)):
+                    resp = rf.readline()
+                    if not resp:
+                        raise ConnectionError(
+                            "backend closed the connection")
+                    if resp.startswith(b"!shed draining"):
+                        saw_draining = True
+                    out.append(resp)
+                    answered += 1
+                self._note_success(i, answered,
+                                   time.monotonic() - t0)
+                if saw_draining:
+                    self._note_draining(i)
+                return (self._retry_shed(rows, out, pool)
+                        if _retry_shed else out)
+            except (OSError, ConnectionError) as e:
+                # in-order responses: answered rows in ``out`` stand
+                # (credited to this backend); only the tail travels to
+                # a peer. Crediting does NOT clear the failure streak —
+                # _note_failure below still advances the ejection.
+                pending = pending[answered:]
+                if answered:
+                    with self._mu:
+                        b.rows += answered
+                    self._rows_c.labels(endpoint=b.key).inc(answered)
+                self._drop(pool, i)
+                self._note_failure(i, attempts, e)
+            finally:
+                with self._mu:
+                    b.in_flight -= n
+        return out
+
+    # ------------------------------------------------------ aggregation
+    def _probe_json(self, b: _Backend, line: bytes) -> dict:
+        """One-shot control call on a fresh connection (fresh on purpose:
+        under a SO_REUSEPORT takeover it reaches whichever replica
+        currently owns fresh connections — the thing a health poll is
+        supposed to measure)."""
+        s = socket.create_connection((b.host, b.port),
+                                     timeout=self.probe_timeout)
+        try:
+            s.sendall(line + b"\n")
+            rf = s.makefile("rb")
+            resp = rf.readline()
+            if not resp or resp.startswith(b"!err"):
+                raise ConnectionError(
+                    resp.rstrip(b"\n").decode() or "connection closed")
+            return json.loads(resp)
+        finally:
+            try:
+                s.close()
+            except OSError:  # pragma: no cover
+                pass
+
+    def backends_snapshot(self) -> List[dict]:
+        now = time.monotonic()
+        with self._mu:
+            return [{"endpoint": b.key, "in_flight": b.in_flight,
+                     "ewma_ms": round(b.ewma_ms, 3), "fails": b.fails,
+                     "ejected": b.down_until > now, "rows": b.rows,
+                     "ejections": b.ejections}
+                    for b in self._backends]
+
+    def health_snapshot(self) -> dict:
+        """Fleet-wide #health: ready while ANY replica is ready (that is
+        what a router buys you), per-replica payloads attached so one
+        poll shows which replica is the problem."""
+        replicas = []
+        ready = queue_depth = 0
+        for b in self._backends:
+            try:
+                h = self._probe_json(b, b"#health")
+            except (OSError, ConnectionError, ValueError) as e:
+                replicas.append({"endpoint": b.key, "error": str(e)})
+                continue
+            replicas.append(dict(h, endpoint=b.key))
+            if h.get("status") == "ready":
+                ready += 1
+            queue_depth += int(h.get("queue_depth", 0))
+        return {"status": "ready" if ready else "down",
+                "router": True, "pid": os.getpid(),
+                "server_id": f"router.{os.getpid()}.{id(self):x}",
+                "replicas_live": ready,
+                "replicas_total": len(self._backends),
+                "queue_depth": queue_depth,
+                "replicas": replicas}
+
+    def stats_snapshot(self) -> dict:
+        """Router counters + balance state + the fleet's summed serving
+        counters (each replica's #stats, best-effort)."""
+        fleet: Dict[str, float] = {}
+        replicas = []
+        for b in self._backends:
+            try:
+                st = self._probe_json(b, b"#stats")
+            except (OSError, ConnectionError, ValueError) as e:
+                replicas.append({"endpoint": b.key, "error": str(e)})
+                continue
+            replicas.append(dict(st, endpoint=b.key))
+            for k in ("requests", "responses", "shed", "errors",
+                      "batches"):
+                if k in st:
+                    fleet[k] = fleet.get(k, 0) + st[k]
+        with self._mu:
+            rows = sum(b.rows for b in self._backends)
+        return {"router": True,
+                "rows": rows,
+                "retries": int(self._retry_c.value()),
+                "shed": int(self._shed_c.value()),
+                "errors": int(self._err_c.value()),
+                "backends": self.backends_snapshot(),
+                "fleet": fleet, "replicas": replicas}
+
+    def metrics_text(self) -> str:
+        """Prometheus text for ``#metrics``: the router registry
+        (per-endpoint labeled forward counters + balance gauges) merged
+        with the process-global registry (fault fires)."""
+        from ..obs import REGISTRY, merge_into, render_prometheus
+        now = time.monotonic()
+        up = self.obs.gauge("router_backend_up",
+                            "1 while the backend is not ejected")
+        infl = self.obs.gauge("router_backend_in_flight",
+                              "rows currently forwarded to the backend")
+        ewma = self.obs.gauge("router_backend_ewma_ms",
+                              "recent per-row backend latency (EWMA)")
+        with self._mu:
+            for b in self._backends:
+                up.labels(endpoint=b.key).set(
+                    0.0 if b.down_until > now else 1.0)
+                infl.labels(endpoint=b.key).set(b.in_flight)
+                ewma.labels(endpoint=b.key).set(b.ewma_ms)
+        snap = merge_into(self.obs.snapshot(), REGISTRY.snapshot())
+        return render_prometheus(snap)
+
+    def _control(self, line: bytes) -> bytes:
+        if line == b"#health":
+            return (json.dumps(self.health_snapshot()) + "\n").encode()
+        if line == b"#stats":
+            return (json.dumps(self.stats_snapshot()) + "\n").encode()
+        if line == b"#metrics":
+            # multi-line payload, blank-line terminated (server.py
+            # contract — ServeClient.metrics() works unchanged)
+            return self.metrics_text().encode() + b"\n"
+        self._err_c.inc()
+        return b"!err router: unsupported control %s\n" % line[:32]

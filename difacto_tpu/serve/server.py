@@ -220,6 +220,9 @@ class ServeServer:
             "status": "draining" if self.draining else "ready",
             "queue_depth": self.batcher.rows_queued,
             "queue_cap": self.batcher.queue_cap,
+            # the third regression signal the rolling-restart health
+            # gate (serve/fleet.py) reads, next to ready + queue depth
+            "shed_rate": self.stats.shed_rate(),
             "model_generation": self.executor.generation,
             "pid": os.getpid(),
             "server_id": f"{os.getpid()}.{id(self):x}",

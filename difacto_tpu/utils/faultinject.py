@@ -20,7 +20,13 @@ run of a real cluster) arm through one environment variable:
   server.py — a botched replica rotation stand-in), ``reload.warm``
   (each bucket of a blue/green warm loop, serve/reload.py — ``err``
   aborts the swap with the old model still serving, ``delay_ms``
-  stretches the warm window for drain-race tests).
+  stretches the warm window for drain-race tests), ``router.forward``
+  (the routing tier's backend forward path, serve/router.py — ``err``/
+  ``close`` model a backend dying mid-chunk and must surface as a peer
+  retry, never a client error), ``fleet.handoff`` (each replica's
+  handoff step of a rolling restart, serve/fleet.py — ``err`` models a
+  botched rotation and must abort the rollout with the incumbent still
+  serving).
 - ``kind`` — what happens when the fault fires:
     - ``err``      raise :class:`FaultInjected` (an OSError, so IO call
                    sites treat it exactly like a real IO failure);

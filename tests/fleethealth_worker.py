@@ -1,0 +1,33 @@
+"""Subprocess worker for the fleethealth concurrent-writer test.
+
+Loads serve/fleethealth.py straight from its file path — NOT through the
+difacto_tpu package — so each writer process costs a few milliseconds,
+not a jax import. The module is deliberately dependency-free (stdlib
+only) precisely so other tools can do the same.
+
+Usage: fleethealth_worker.py <fleethealth.py> <blacklist> <tag> <n>
+"""
+
+import importlib.util
+import sys
+
+
+def load_module(path):
+    spec = importlib.util.spec_from_file_location("fleethealth", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if __name__ == "__main__":
+    module_path, bl_path, tag, n = sys.argv[1:5]
+    fh = load_module(module_path).FleetHealth(
+        bl_path, down_s=60.0, max_bytes=1 << 30)
+    for k in range(int(n)):
+        # alternate down/clear over a small endpoint set: maximal
+        # contention on the same file, interleaved with the other writer
+        if k % 2 == 0:
+            fh.mark_down(f"host-{tag}", 1000 + k % 7)
+        else:
+            fh.mark_up(f"host-{tag}", 1000 + k % 7)
+    print("done")

@@ -897,6 +897,272 @@ def test_sigterm_during_bluegreen_warm_drains_cleanly(tmp_path):
             proc.wait()
 
 
+# ------------------------------------- fleet-scale serving (ISSUE 6)
+
+def _serve_or_skip(store, **kw):
+    """Fleet tests bind several real ports; a box that cannot bind skips
+    cleanly instead of erroring (the tier-1 contract for these tests)."""
+    from difacto_tpu.serve import ServeServer
+    try:
+        return ServeServer(store, **kw).start()
+    except OSError as e:  # pragma: no cover - loaded/locked-down CI box
+        pytest.skip(f"cannot bind a serving port: {e}")
+
+
+def _fleet(tmp_path, n=3, model=None):
+    """n in-process takeover-ready replicas over one synthetic model.
+    Returns (model, servers dict endpoint->list, endpoints list)."""
+    from difacto_tpu.serve import open_serving_store
+    model = model or _synth_model(tmp_path, "m", vdim=4)
+    servers, endpoints = {}, []
+    for _ in range(n):
+        store, _, _ = open_serving_store(model)
+        srv = _serve_or_skip(store, batch_size=64, max_delay_ms=2.0,
+                             takeover=True)
+        servers[f"{srv.host}:{srv.port}"] = [srv]
+        endpoints.append((srv.host, srv.port))
+    return model, servers, endpoints
+
+
+def _inproc_spawn(model, servers):
+    """spawn_fn for run_rolling_restart: an in-process successor on the
+    shared SO_REUSEPORT port (no second jax process), registered in
+    ``servers`` for teardown."""
+    from difacto_tpu.serve import ServeServer, open_serving_store
+
+    def spawn(i, host, port, ready_file):
+        store, _, _ = open_serving_store(model)
+        srv = ServeServer(store, host=host, port=port, batch_size=64,
+                          max_delay_ms=2.0, takeover=True).start()
+        srv.ready_file = ready_file
+        with open(ready_file, "w") as f:
+            f.write(f"{srv.host} {srv.port}\n")
+        servers[f"{host}:{port}"].append(srv)
+        return None
+
+    return spawn
+
+
+def _close_fleet(*groups):
+    for g in groups:
+        for lst in (g.values() if isinstance(g, dict) else [g]):
+            for srv in (lst if isinstance(lst, list) else [lst]):
+                srv.close()
+
+
+def test_fleet_rolling_restart_behind_router_under_load(tmp_path):
+    """Acceptance (ISSUE 6 headline): rolling restart of 3 replicas
+    behind the router under open-loop loadgen — every replica replaced,
+    ZERO client-visible !err lines (and zero sheds: the router converts
+    each drain window into peer re-forwards), and the router reports the
+    whole fleet ready afterwards."""
+    sys.path.insert(0, str(REPO / "tools"))
+    from loadgen import run_loadgen
+
+    from difacto_tpu.serve import (RouterServer, ServeClient,
+                                   run_rolling_restart)
+
+    rows = _synth_rows(64)
+    with deadline(600):
+        model, servers, endpoints = _fleet(tmp_path, n=3)
+        try:
+            router = RouterServer(
+                endpoints, blacklist=str(tmp_path / "blacklist")).start()
+        except OSError as e:  # pragma: no cover
+            _close_fleet(servers)
+            pytest.skip(f"cannot bind the router port: {e}")
+        rep = {}
+        t = threading.Thread(target=lambda: rep.update(
+            run_loadgen(router.host, router.port, rows, qps=100,
+                        duration_s=6.0)))
+        try:
+            t.start()
+            time.sleep(1.0)    # traffic established through the router
+            roll = run_rolling_restart(
+                endpoints, spawn_fn=_inproc_spawn(model, servers),
+                wait_s=60.0)
+            t.join()
+            assert roll["ok"], roll
+            assert len(roll["replicas"]) == 3, roll
+            for r in roll["replicas"]:
+                assert r["incumbent"] != r["successor"], r
+            # the headline: a full fleet rotation cost the client NOTHING
+            assert rep["err"] == 0, rep
+            assert rep["shed"] == 0, rep
+            assert rep["ok"] > 0, rep
+            with ServeClient(router.host, router.port) as c:
+                h = c.health()
+                assert h["router"] and h["status"] == "ready"
+                assert h["replicas_live"] == 3, h
+                # every replica answering is a successor, and their
+                # health payloads ride the aggregate
+                ids = {r["server_id"] for r in h["replicas"]}
+                assert ids == {r["successor"]
+                               for r in roll["replicas"]}, h
+                st = c.stats()
+                assert st["rows"] >= rep["ok"], st
+                assert sum(b["rows"] for b in st["backends"]) \
+                    >= rep["ok"], st
+        finally:
+            router.close()
+            _close_fleet(servers)
+
+
+def test_fleet_rolling_restart_aborts_on_ready_timeout(tmp_path):
+    """Acceptance (abort leg): replica 0 rolls, replica 1's successor
+    never becomes ready — the rollout ABORTS with replica 1's incumbent
+    still serving and replica 2 untouched."""
+    from difacto_tpu.serve import run_rolling_restart
+    from difacto_tpu.serve.fleet import fresh_health
+
+    class _NeverReady:
+        terminated = False
+
+        def poll(self):
+            return None
+
+        def terminate(self):
+            self.terminated = True
+
+    with deadline(300):
+        model, servers, endpoints = _fleet(tmp_path, n=3)
+        good_spawn = _inproc_spawn(model, servers)
+        stuck = _NeverReady()
+
+        def spawn(i, host, port, ready_file):
+            if i == 1:
+                return stuck     # writes no ready file, ever
+            return good_spawn(i, host, port, ready_file)
+
+        try:
+            before = {ep: fresh_health(*ep)["server_id"]
+                      for ep in endpoints}
+            roll = run_rolling_restart(endpoints, spawn_fn=spawn,
+                                       wait_s=2.0, gate_wait_s=5.0)
+            assert not roll["ok"], roll
+            assert roll["aborted_at"] == 1, roll
+            assert "ready" in roll["reason"], roll
+            assert len(roll["completed"]) == 1, roll
+            assert stuck.terminated    # the half-up successor was reaped
+            # replica 1's incumbent kept serving; replica 2 untouched
+            for ep in endpoints[1:]:
+                h = fresh_health(*ep)
+                assert h["status"] == "ready", h
+                assert h["server_id"] == before[ep], h
+            # replica 0 WAS replaced before the abort
+            assert fresh_health(*endpoints[0])["server_id"] \
+                != before[endpoints[0]]
+        finally:
+            _close_fleet(servers)
+
+
+def test_fleet_handoff_fault_aborts_rollout(tmp_path):
+    """Satellite + acceptance (abort leg): the ``fleet.handoff``
+    injection point fires at the orchestrator's handoff step, lands in
+    faults_fired_total{point,kind}, and an injected err mid-rollout
+    aborts with the incumbent still serving."""
+    from difacto_tpu.obs import REGISTRY
+    from difacto_tpu.serve import run_rolling_restart
+    from difacto_tpu.serve.fleet import fresh_health
+
+    before_f = REGISTRY.value("faults_fired_total",
+                              point="fleet.handoff", kind="err")
+    with deadline(300):
+        model, servers, endpoints = _fleet(tmp_path, n=2)
+        try:
+            before = {ep: fresh_health(*ep)["server_id"]
+                      for ep in endpoints}
+            # after_n=1: replica 0's handoff step passes, replica 1's
+            # fires — a mid-rollout botched rotation
+            faultinject.configure("fleet.handoff:err@1:1")
+            roll = run_rolling_restart(
+                endpoints, spawn_fn=_inproc_spawn(model, servers),
+                wait_s=60.0, gate_wait_s=5.0)
+            faultinject.configure("")
+            assert not roll["ok"], roll
+            assert roll["aborted_at"] == 1, roll
+            assert "fleet.handoff" in roll["reason"], roll
+            assert len(roll["completed"]) == 1, roll
+            h = fresh_health(*endpoints[1])
+            assert h["status"] == "ready", h
+            assert h["server_id"] == before[endpoints[1]], \
+                "the aborted replica's incumbent was disturbed"
+        finally:
+            faultinject.configure("")
+            _close_fleet(servers)
+    assert faultinject.stats() == {}, "registry should be disarmed"
+    assert REGISTRY.value("faults_fired_total", point="fleet.handoff",
+                          kind="err") > before_f
+
+
+def test_fleet_rolling_restart_gate_rejects_unready_fleet(tmp_path):
+    """Pre-handoff gate: a fleet with a draining replica never starts a
+    rollout — the first health pass aborts before any successor spawns
+    (ready=false is the first regression class the gate names)."""
+    from difacto_tpu.serve import run_rolling_restart
+
+    with deadline(300):
+        model, servers, endpoints = _fleet(tmp_path, n=2)
+        try:
+            # replica 1 reports draining (a rotation already in flight)
+            list(servers.values())[1][0].draining = True
+            spawned = []
+
+            def spawn(i, host, port, ready_file):  # pragma: no cover
+                spawned.append(i)
+                return None
+
+            roll = run_rolling_restart(endpoints, spawn_fn=spawn,
+                                       wait_s=5.0, gate_wait_s=0.5)
+            assert not roll["ok"], roll
+            assert roll["aborted_at"] == 0 and not roll["completed"]
+            assert "not ready" in roll["reason"], roll
+            assert not spawned, "gate must abort before any spawn"
+        finally:
+            _close_fleet(servers)
+
+
+def test_router_forward_fault_retries_on_peer(tmp_path):
+    """Satellite: the ``router.forward`` injection point fires in the
+    forward path, lands in faults_fired_total{point,kind}, and an
+    injected mid-chunk close surfaces as a peer retry — the client sees
+    every row answered, zero errors."""
+    from difacto_tpu.obs import REGISTRY
+    from difacto_tpu.serve import RouterServer, ServeClient
+
+    before_f = REGISTRY.value("faults_fired_total",
+                              point="router.forward", kind="close")
+    rows = _synth_rows(40)
+    with deadline(300):
+        model, servers, endpoints = _fleet(tmp_path, n=2)
+        try:
+            router = RouterServer(endpoints, retries=4).start()
+        except OSError as e:  # pragma: no cover
+            _close_fleet(servers)
+            pytest.skip(f"cannot bind the router port: {e}")
+        try:
+            # every 4th forward tears its backend connection mid-chunk
+            faultinject.configure("router.forward:close@1:3")
+            with ServeClient(router.host, router.port) as c:
+                for k in range(0, 40, 5):
+                    got = c.predict(rows[k:k + 5])
+                    assert all(g is not None for g in got), (k, got)
+            fired = faultinject.stats()
+            assert fired.get("router.forward", 0) >= 1, \
+                f"injected close never fired: {fired}"
+            st = router.stats_snapshot()
+            assert st["retries"] >= 1, st
+            assert st["errors"] == 0, st
+            # both backends carried rows: the retried tails crossed over
+            assert all(b["rows"] > 0 for b in st["backends"]), st
+        finally:
+            faultinject.configure("")
+            router.close()
+            _close_fleet(servers)
+    assert REGISTRY.value("faults_fired_total", point="router.forward",
+                          kind="close") > before_f
+
+
 # ------------------------------- family-wide pruning (ISSUE 4 satellite)
 
 def test_ckpt_keep_prunes_whole_family(ckpt_model, rcv1_path, tmp_path):

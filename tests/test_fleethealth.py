@@ -1,0 +1,189 @@
+"""Shared endpoint health (serve/fleethealth.py): the fleet-wide
+blacklist file under the conditions that would corrupt a naive design —
+concurrent writers from separate processes, stale entries, and the
+client-side contract that a blacklisted endpoint is skipped on the FIRST
+connect (no timeout paid) while the timed re-probe still clears it.
+
+Part of the fleet suite (``chaos`` marker, tier-1): ``make fleet-chaos``
+selects these together with the rolling-restart/router chaos tests.
+"""
+
+import contextlib
+import json
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from difacto_tpu.serve.fleethealth import FleetHealth, open_blacklist
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.chaos
+
+
+@contextlib.contextmanager
+def deadline(seconds: int):
+    def on_alarm(signum, frame):
+        raise TimeoutError(f"test exceeded {seconds}s deadline")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def test_fleethealth_concurrent_writers_two_processes(tmp_path):
+    """Two separate PROCESSES hammer the same blacklist file with
+    interleaved down/clear marks; the advisory-locked O_APPEND protocol
+    must leave every line intact — exact count, all parseable — not a
+    torn or interleaved log."""
+    bl = str(tmp_path / "blacklist")
+    module = str(REPO / "difacto_tpu" / "serve" / "fleethealth.py")
+    worker = str(REPO / "tests" / "fleethealth_worker.py")
+    n = 200
+    with deadline(120):
+        procs = [subprocess.Popen(
+            [sys.executable, worker, module, bl, tag, str(n)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            for tag in ("a", "b")]
+        for p in procs:
+            out, err = p.communicate(timeout=90)
+            assert p.returncode == 0, err.decode()[-2000:]
+    lines = open(bl, "rb").read().splitlines()
+    assert len(lines) == 2 * n, f"expected {2*n} marks, got {len(lines)}"
+    for ln in lines:
+        rec = json.loads(ln)   # every line parses — no torn appends
+        assert rec["op"] in ("down", "clear") and ":" in rec["ep"]
+    # the fold sees both writers' endpoints
+    fh = FleetHealth(bl, down_s=60.0)
+    downs = fh.down_endpoints()
+    assert any(ep.startswith("host-a") for ep in downs)
+    assert any(ep.startswith("host-b") for ep in downs)
+
+
+def test_fleethealth_stale_entries_reprobe_and_clear(tmp_path):
+    """A down mark only suppresses for ``down_s`` (the timed re-probe
+    window), and an explicit clear lifts it immediately — plus a fresh
+    reader handle sees both transitions through the file."""
+    bl = str(tmp_path / "blacklist")
+    fh = FleetHealth(bl, down_s=0.3)
+    fh.mark_down("h", 9000)
+    assert fh.is_down("h", 9000)
+    # (<= down_s + 1ms: the mark's wall timestamp is rounded to 1ms)
+    assert 0.0 < fh.down_remaining("h", 9000) <= 0.301
+    # a second handle (another process's view) folds the same state
+    assert FleetHealth(bl, down_s=0.3).is_down("h", 9000)
+    time.sleep(0.35)
+    assert not fh.is_down("h", 9000), \
+        "stale down mark outlived its re-probe window"
+    # a successful probe clears fleet-wide, ahead of the window
+    fh.mark_down("h", 9000)
+    assert fh.is_down("h", 9000)
+    fh.mark_up("h", 9000)
+    assert not fh.is_down("h", 9000)
+    assert not FleetHealth(bl, down_s=0.3).is_down("h", 9000)
+    # unrelated endpoints never blur
+    fh.mark_down("other", 9001)
+    assert not fh.is_down("h", 9000) and fh.is_down("other", 9001)
+
+
+def test_fleethealth_missing_and_torn_files_degrade_clean(tmp_path):
+    """Shared health is an optimization, never a dependency: a missing
+    file reads as nothing-down, and garbage/torn lines are skipped
+    while intact marks still fold."""
+    fh = FleetHealth(str(tmp_path / "never_written"))
+    assert fh.down_endpoints() == {}
+    bl = str(tmp_path / "torn")
+    good = FleetHealth(bl, down_s=60.0)
+    good.mark_down("h", 1)
+    with open(bl, "ab") as f:
+        f.write(b'{"ts": 1, "op"')   # a writer died mid-append
+    good.mark_down("h2", 2)
+    downs = FleetHealth(bl, down_s=60.0).down_endpoints()
+    assert set(downs) == {"h:1", "h2:2"}
+    # open_blacklist coerces paths and passes handles through
+    assert open_blacklist(None) is None
+    assert open_blacklist(good) is good
+    assert isinstance(open_blacklist(bl), FleetHealth)
+
+
+def test_fleethealth_compaction_bounds_file(tmp_path):
+    """Past ``max_bytes`` the appender folds the log in place: the file
+    stays bounded and only live down marks survive."""
+    bl = str(tmp_path / "blacklist")
+    fh = FleetHealth(bl, down_s=60.0, max_bytes=2048)
+    for k in range(200):
+        fh.mark_down("h", 7000 + (k % 3))
+        fh.mark_up("h", 7000 + (k % 3))
+    fh.mark_down("live", 8000)
+    size = pathlib.Path(bl).stat().st_size
+    assert size < 2048 + 512, f"compaction never ran: {size} bytes"
+    assert FleetHealth(bl, down_s=60.0).is_down("live", 8000)
+
+
+def _dead_endpoint():
+    """A (host, port) that refuses connections: bind, record, close."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    host, port = s.getsockname()[:2]
+    s.close()
+    return host, port
+
+
+def test_fleethealth_client_skips_blacklisted_on_first_connect(tmp_path):
+    """A ServeClient seeded with a blacklisted endpoint never dials it:
+    zero connect failures, zero failovers — the whole point of sharing
+    the discovery — and one client's ejection seeds the next client."""
+    from difacto_tpu.serve import ServeClient, ServeServer
+    from difacto_tpu.serve.executor import PredictExecutor  # noqa: F401
+    from difacto_tpu.store.local import SlotStore
+    from difacto_tpu.updaters.sgd_updater import (SGDUpdaterParam,
+                                                  set_all_live)
+
+    param = SGDUpdaterParam(V_dim=4, l1_shrk=False, hash_capacity=4096)
+    store = SlotStore(param, read_only=True)
+    store.state = set_all_live(param, store.state)
+    with deadline(120):
+        try:
+            srv = ServeServer(store, batch_size=8,
+                              max_delay_ms=1.0).start()
+        except OSError as e:  # pragma: no cover - loaded CI box
+            pytest.skip(f"cannot bind a serving port: {e}")
+        dead = _dead_endpoint()
+        bl = str(tmp_path / "blacklist")
+        try:
+            # client A discovers the dead endpoint the hard way: its
+            # ejection (eject_after=1: one connect failure is enough —
+            # the client fails over and never revisits, so a higher
+            # threshold would never trip here) lands in the shared file
+            with ServeClient(endpoints=[dead, (srv.host, srv.port)],
+                             retries=3, eject_after=1, backoff_s=0.01,
+                             blacklist=bl) as ca:
+                assert ca.failovers >= 1
+                eh = ca.endpoints_health()
+                assert eh[0]["ejected"] and eh[0]["ejections"] >= 1
+                assert ca.predict([b"0 5:1 17:1"])[0] is not None
+                assert eh[1]["host"] == srv.host
+            assert FleetHealth(bl, down_s=5.0).is_down(*dead)
+            # client B is seeded: FIRST connect skips the dead endpoint
+            # entirely — no dial, no failure, no failover
+            with ServeClient(endpoints=[dead, (srv.host, srv.port)],
+                             retries=1, blacklist=bl) as cb:
+                assert cb.failovers == 0
+                assert (cb.host, cb.port) == (srv.host, srv.port)
+                eh = cb.endpoints_health()
+                assert eh[0]["ejected"] and eh[0]["fails"] == 0
+                got = cb.predict([b"0 5:1 17:1", b"0 3:2"])
+                assert all(g is not None for g in got)
+                # the live endpoint carried every row
+                assert cb.endpoints_health()[1]["rows"] >= 2
+        finally:
+            srv.close()

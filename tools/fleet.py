@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Fleet operations CLI: rolling restarts, the routing tier, health.
+
+Three subcommands over one replica list (``--endpoints h1:p1,h2:p2``):
+
+``roll``
+    Health-gated rolling restart (difacto_tpu/serve/fleet.py): replace
+    every replica one at a time — spawn successor on the shared
+    SO_REUSEPORT port, wait for its ready file, ``#handoff``, verify —
+    polling every replica's ``#health`` before and after each handoff.
+    Any regression (not ready, queue-depth blowup, shed-rate spike,
+    successor ready timeout) ABORTS the rollout with the current
+    incumbent still serving. Prints one JSON report line.
+
+        python tools/fleet.py roll --endpoints 127.0.0.1:9000,127.0.0.1:9001 \\
+            --model /models/ctr_v2 --serve-arg serve_batch_size=256
+
+``route``
+    Start the thin router process (difacto_tpu/serve/router.py): speaks
+    the same libsvm/control wire protocol, balances rows across the
+    replicas with power-of-two-choices over live (in-flight, recent
+    latency), retries an unanswered tail on a peer, serves aggregated
+    ``#health``/``#stats``/``#metrics`` for the whole fleet, and shares
+    endpoint ejections through ``--blacklist``.
+
+        python tools/fleet.py route --endpoints 127.0.0.1:9000,127.0.0.1:9001 \\
+            --port 9100 --blacklist /tmp/fleet.blacklist
+
+``health``
+    One gate pass over every replica; prints the regression (exit 1) or
+    the all-healthy report (exit 0) — the preflight an operator runs
+    before trusting a rollout to the gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def cmd_roll(args) -> int:
+    from difacto_tpu.serve.fleet import HealthGate, run_rolling_restart
+    gate = HealthGate(queue_frac=args.queue_frac,
+                      shed_spike=args.shed_spike)
+    rep = run_rolling_restart(args.endpoints, model=args.model,
+                              extra=args.serve_arg, wait_s=args.wait_s,
+                              gate=gate)
+    print(json.dumps(rep))
+    return 0 if rep["ok"] else 1
+
+
+def cmd_route(args) -> int:
+    from difacto_tpu.serve.router import RouterServer
+    router = RouterServer(args.endpoints, host=args.host, port=args.port,
+                          chunk=args.chunk, retries=args.retries,
+                          blacklist=args.blacklist or None)
+    router.start()
+    if args.ready_file:
+        with open(args.ready_file, "w") as f:
+            f.write(f"{router.host} {router.port}\n")
+    print(json.dumps({"router": f"{router.host}:{router.port}",
+                      "endpoints": args.endpoints}), flush=True)
+    try:
+        router.wait(args.max_seconds or None)
+    except KeyboardInterrupt:  # pragma: no cover - interactive exit
+        pass
+    finally:
+        router.close()
+    return 0
+
+
+def cmd_health(args) -> int:
+    from difacto_tpu.config import parse_endpoints
+    from difacto_tpu.serve.fleet import HealthGate, fresh_health
+    eps = parse_endpoints(args.endpoints)
+    gate = HealthGate(queue_frac=args.queue_frac,
+                      shed_spike=args.shed_spike)
+    reason = gate.check(eps)
+    replicas = []
+    for host, port in eps:
+        try:
+            replicas.append(dict(fresh_health(host, port),
+                                 endpoint=f"{host}:{port}"))
+        except (OSError, ConnectionError, ValueError) as e:
+            replicas.append({"endpoint": f"{host}:{port}",
+                             "error": str(e)})
+    print(json.dumps({"ok": reason is None, "reason": reason,
+                      "replicas": replicas}))
+    return 0 if reason is None else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--endpoints", required=True,
+                        help="replica list, h1:p1,h2:p2")
+    common.add_argument("--queue-frac", type=float, default=0.9,
+                        help="gate: abort past this fraction of a "
+                             "replica's queue_cap")
+    common.add_argument("--shed-spike", type=float, default=0.25,
+                        help="gate: abort when shed_rate rises this much "
+                             "over the rollout-start baseline")
+
+    roll = sub.add_parser("roll", parents=[common],
+                          help="health-gated rolling restart")
+    roll.add_argument("--model", required=True,
+                      help="model_in for the successor processes")
+    roll.add_argument("--serve-arg", action="append", default=[],
+                      help="extra k=v for successors (repeatable)")
+    roll.add_argument("--wait-s", type=float, default=180.0)
+    roll.set_defaults(fn=cmd_roll)
+
+    route = sub.add_parser("route", parents=[common],
+                           help="start the routing tier")
+    route.add_argument("--host", default="127.0.0.1")
+    route.add_argument("--port", type=int, default=0)
+    route.add_argument("--chunk", type=int, default=64,
+                       help="max rows pipelined per backend forward")
+    route.add_argument("--retries", type=int, default=2,
+                       help="per-backend retry budget per forward")
+    route.add_argument("--blacklist", default="",
+                       help="shared endpoint-health file "
+                            "(serve/fleethealth.py)")
+    route.add_argument("--ready-file", default="",
+                       help="write 'host port' here once listening")
+    route.add_argument("--max-seconds", type=float, default=0.0)
+    route.set_defaults(fn=cmd_route)
+
+    health = sub.add_parser("health", parents=[common],
+                            help="one gate pass over the fleet")
+    health.set_defaults(fn=cmd_health)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,7 +23,13 @@ but rows travel through the multi-endpoint ``ServeClient``
 (serve/client.py) in small pipelined chunks — a killed or draining
 replica shows up as failovers and retried tails, not client errors.
 This is the harness the takeover/blue-green chaos tests point at a
-replica pair to prove "zero client-visible errors".
+replica pair to prove "zero client-visible errors". The report's
+``endpoints`` section is a PER-ENDPOINT summary (rows answered,
+failovers, ejections — ``ServeClient.endpoints_health()``), so a
+rolling-restart run shows which replica absorbed each handoff window.
+``--blacklist FILE`` joins the fleet's shared endpoint health
+(serve/fleethealth.py): ejections propagate to/from every other client
+and the router.
 """
 
 from __future__ import annotations
@@ -164,13 +170,18 @@ def run_loadgen(host: str, port: int, rows: Sequence[Line], qps: float,
 def run_loadgen_failover(endpoints, rows: Sequence[Line], qps: float,
                          duration_s: float, seed: int = 0,
                          retries: int = 8, chunk: int = 64,
-                         timeout: float = 30.0) -> dict:
+                         timeout: float = 30.0, blacklist=None) -> dict:
     """Open-loop schedule over the failover ``ServeClient``: due rows
     are pipelined in chunks of at most ``chunk``; a dropped replica is
     absorbed by the client (reconnect / next endpoint / resend tail),
     so only genuine ``!err`` rows or exhausted budgets count as errors.
     Latency is measured from each row's SCHEDULED arrival, so queueing
-    behind a failover window is charged honestly."""
+    behind a failover window is charged honestly. ``blacklist`` (path or
+    FleetHealth) wires the client into the fleet's shared endpoint
+    health (serve/fleethealth.py). The report's ``endpoints`` list is
+    the per-endpoint summary — rows answered, failovers absorbed,
+    ejections — so a rollout chaos run shows WHICH replica carried the
+    handoff window, not just fleet totals."""
     from difacto_tpu.serve import ServeClient
     rows = [_to_bytes(r) for r in rows]
     if not rows:
@@ -178,7 +189,7 @@ def run_loadgen_failover(endpoints, rows: Sequence[Line], qps: float,
     rng = np.random.RandomState(seed)
     client = ServeClient(endpoints=endpoints, retries=retries,
                          backoff_s=0.02, backoff_max_s=0.5,
-                         timeout=timeout)
+                         timeout=timeout, blacklist=blacklist)
     lat_ok: List[float] = []
     n_ok = n_shed = n_err = sent = 0
     i = 0
@@ -253,6 +264,9 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--retries", type=int, default=8,
                     help="per-endpoint retry budget (failover mode)")
+    ap.add_argument("--blacklist", default="",
+                    help="shared endpoint-health file (failover mode; "
+                         "serve/fleethealth.py)")
     args = ap.parse_args()
     if not args.endpoints and args.port is None:
         ap.error("pass --port or --endpoints")
@@ -264,9 +278,19 @@ def main() -> None:
         import sys as _sys
         _sys.path.insert(0, os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
-        print(json.dumps(run_loadgen_failover(
+        rep = run_loadgen_failover(
             args.endpoints, rows, args.qps, args.duration,
-            seed=args.seed, retries=args.retries)))
+            seed=args.seed, retries=args.retries,
+            blacklist=args.blacklist or None)
+        print(json.dumps(rep))
+        # the per-endpoint summary, one human line each: which replica
+        # answered the rows, who failed over, who got ejected
+        import sys
+        for e in rep["endpoints"]:
+            print(f"# {e['host']}:{e['port']} rows={e['rows']} "
+                  f"fails={e['fails']} ejections={e['ejections']} "
+                  f"ejected={e['ejected']} active={e['active']}",
+                  file=sys.stderr)
     else:
         print(json.dumps(run_loadgen(args.host, args.port, rows, args.qps,
                                      args.duration, seed=args.seed)))

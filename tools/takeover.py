@@ -18,15 +18,11 @@ connection answered ready by the successor (an upper bound on any
 client-visible gap; with SO_REUSEPORT the successor was already
 accepting throughout, so the true gap is ~0).
 
-The routing subtlety this driver encodes: once two processes listen on
-one port, a FRESH connection hashes to either of them — but an
-ESTABLISHED connection stays with its owner. So the driver connects to
-the incumbent BEFORE the successor binds and holds that connection; the
-later ``#handoff`` provably reaches the incumbent. (A mis-routed handoff
-is also safe — a replica that owns the named ready file refuses it.)
-
-Importable as ``run_takeover`` — tests drive it with an in-process
-``spawn_fn`` instead of a subprocess successor.
+The sequencing lives in ``difacto_tpu/serve/fleet.py`` (run_takeover is
+the single-replica primitive of the health-gated rolling restart —
+``tools/fleet.py roll`` repeats it across a whole replica list). This
+wrapper keeps the one-replica CLI and the ``run_takeover`` import the
+tests use.
 """
 
 from __future__ import annotations
@@ -34,134 +30,19 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import socket
-import subprocess
 import sys
-import tempfile
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
+from difacto_tpu.serve.fleet import (  # noqa: E402  (path setup first)
+    EndpointRpc, run_takeover, spawn_successor)
 
-class _Rpc:
-    """One newline-JSON control channel over a held TCP connection."""
+# back-compat aliases: scripts importing the pre-fleet module layout
+_Rpc = EndpointRpc
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
-        self.sock = socket.create_connection((host, port),
-                                             timeout=timeout)
-        self.rfile = self.sock.makefile("rb")
-
-    def call(self, line: str) -> dict:
-        self.sock.sendall(line.encode() + b"\n")
-        resp = self.rfile.readline()
-        if not resp:
-            raise ConnectionError("connection closed")
-        if resp.startswith(b"!err"):
-            raise ConnectionError(resp.rstrip(b"\n").decode())
-        return json.loads(resp)
-
-    def close(self) -> None:
-        try:
-            self.rfile.close()
-            self.sock.close()
-        except OSError:
-            pass
-
-
-def _fresh_health(host: str, port: int, timeout: float = 5.0) -> dict:
-    rpc = _Rpc(host, port, timeout=timeout)
-    try:
-        return rpc.call("#health")
-    finally:
-        rpc.close()
-
-
-def spawn_successor(model: str, port: int, ready_file: str,
-                    extra=()) -> "subprocess.Popen":
-    """Default successor: a fresh task=serve process on the shared port
-    (serve_takeover=1 so the kernel accepts the second binding). Its
-    output goes to ``<ready_file>.log`` — NOT the driver's inherited
-    pipes, which a parent capturing the driver's output would otherwise
-    wait on for the whole life of the successor."""
-    args = [sys.executable, "-m", "difacto_tpu", "task=serve",
-            f"model_in={model}", f"serve_port={port}", "serve_takeover=1",
-            f"serve_ready_file={ready_file}", *extra]
-    logf = open(ready_file + ".log", "ab")
-    try:
-        return subprocess.Popen(args, cwd=REPO, stdin=subprocess.DEVNULL,
-                                stdout=logf, stderr=logf,
-                                start_new_session=True)
-    finally:
-        logf.close()   # the child holds its own descriptor
-
-
-def run_takeover(host: str, port: int, model: str = "", extra=(),
-                 spawn_fn=None, wait_s: float = 180.0,
-                 poll_s: float = 0.05) -> dict:
-    """Sequence one takeover; returns the report dict. ``spawn_fn``
-    (ready_file -> handle with .poll(), or None) overrides the
-    subprocess successor for in-process tests."""
-    # 1. hold a connection to the incumbent while it is the only
-    #    listener — #handoff later rides this connection, immune to
-    #    SO_REUSEPORT's fresh-connection hashing
-    incumbent = _Rpc(host, port)
-    try:
-        h0 = incumbent.call("#health")
-        if not h0.get("takeover"):
-            raise SystemExit(
-                "incumbent is not running serve_takeover=1 — restart it "
-                "once with the knob before zero-downtime handoffs work")
-        incumbent_id = h0["server_id"]
-
-        # 2. spawn the successor; it loads + warms, binds the shared
-        #    port, then writes its ready file
-        fd, ready_file = tempfile.mkstemp(suffix=".ready")
-        os.close(fd)
-        os.unlink(ready_file)   # the successor's write IS the signal
-        t0 = time.monotonic()
-        proc = (spawn_fn(ready_file) if spawn_fn is not None
-                else spawn_successor(model, port, ready_file, extra))
-        while not os.path.exists(ready_file):
-            if proc is not None and getattr(proc, "poll", None) \
-                    and proc.poll() is not None:
-                raise RuntimeError(
-                    f"successor exited rc={proc.poll()} before ready")
-            if time.monotonic() - t0 > wait_s:
-                raise TimeoutError(
-                    f"successor not ready after {wait_s:.0f}s")
-            time.sleep(poll_s)
-        warm_s = time.monotonic() - t0
-
-        # 3. handoff: the incumbent confirms the ready file, drains and
-        #    exits; its established connections finish first
-        t1 = time.monotonic()
-        res = incumbent.call(f"#handoff {ready_file}")
-
-        # 4. fresh connections answer from the successor, ready
-        while True:
-            try:
-                h = _fresh_health(host, port)
-                if h.get("server_id") != incumbent_id \
-                        and h.get("status") == "ready":
-                    break
-            except (OSError, ConnectionError, ValueError):
-                pass
-            if time.monotonic() - t1 > wait_s:
-                raise TimeoutError("takeover never completed: fresh "
-                                   "connections still reach the "
-                                   "incumbent (or nothing)")
-            time.sleep(poll_s)
-        out = {"ok": True, "incumbent": incumbent_id,
-               "successor": h["server_id"],
-               "model_generation": h.get("model_generation"),
-               "warm_s": round(warm_s, 3), "handoff": res,
-               "takeover_gap_ms":
-                   round((time.monotonic() - t1) * 1e3, 1)}
-        if spawn_fn is None:
-            out["successor_log"] = ready_file + ".log"
-        return out
-    finally:
-        incumbent.close()
+__all__ = ["run_takeover", "spawn_successor", "EndpointRpc"]
 
 
 def main() -> None:
