@@ -10,8 +10,12 @@ PY ?= python
 # and optionally its Chrome trace (DIFACTO_TRACE)
 METRICS ?= run.metrics.jsonl
 TRACE ?=
+# convert inputs (make convert): text in -> rec2 cache out
+DATA_IN ?= data.txt
+DATA_FORMAT ?= criteo
+DATA_OUT ?= $(basename $(DATA_IN)).rec
 
-.PHONY: test smoke ci chaos fleet-chaos obs-report
+.PHONY: test smoke ci chaos fleet-chaos obs-report convert stream-bench
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -42,3 +46,15 @@ ci: test smoke
 #   make obs-report METRICS=run.metrics.jsonl TRACE=run.trace.json
 obs-report:
 	$(PY) tools/obs_report.py --metrics $(METRICS) $(if $(TRACE),--trace $(TRACE))
+
+# one-time text -> rec2 convert (docs/perf_notes.md "Data formats & the
+# streamed fast path"): parallel across cores, zero-copy members out.
+#   make convert DATA_IN=criteo.txt DATA_FORMAT=criteo [DATA_OUT=criteo.rec]
+convert:
+	$(PY) -m difacto_tpu task=convert data_in=$(DATA_IN) \
+	  data_format=$(DATA_FORMAT) data_out=$(DATA_OUT) data_out_format=rec
+
+# streamed-regime bench alone (convert + replay + streamed epochs, with
+# the per-stage breakdown and the delta vs the newest BENCH_r*.json)
+stream-bench:
+	$(PY) bench.py --e2e

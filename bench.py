@@ -197,7 +197,6 @@ def run_e2e(args) -> dict:
         path = f"{d}/criteo.txt"
         _gen_criteo_text(path, nrows)
 
-        t0 = _t.perf_counter()
         conv = Converter()
         conv.init([("data_in", path), ("data_format", "criteo"),
                    ("data_out", f"{d}/criteo.rec"),
@@ -207,7 +206,12 @@ def run_e2e(args) -> dict:
                    # schedule (round-3 verdict #1c)
                    ("rec_batch_size", str(args.e2e_batch))])
         conv.run()
-        convert_eps = nrows / (_t.perf_counter() - t0)
+        # per-stage convert accounting (ISSUE 7 satellite): Converter.run
+        # fills stats with rows/eps/convert_s plus parse_s/write_s and the
+        # worker-process count, so a convert regression localizes to a
+        # stage just like the streamed epochs do
+        convert_stats = dict(conv.stats)
+        convert_eps = convert_stats.get("eps", 0.0)
 
         def train(cache_mb: int, n_epochs: int,
                   producer_mode: str = "thread"):
@@ -256,7 +260,7 @@ def run_e2e(args) -> dict:
     # number is never mistaken for full-HBM replay at larger --e2e-rows
     from difacto_tpu.learners.sgd import K_TRAINING
     train_cache = cache_info.get(K_TRAINING, {})
-    return {
+    out = {
         "metric": "fm_e2e_criteo_examples_per_sec",
         "value": round(replay, 1),
         "unit": "examples/sec",
@@ -281,7 +285,45 @@ def run_e2e(args) -> dict:
         "config": {"rows": nrows, "batch": args.e2e_batch,
                    "epochs_timed": epochs - 1,
                    "text_to_rec_convert_eps": round(convert_eps, 1)},
+        "convert": convert_stats,
     }
+    out["streamed"].update(_vs_prev_bench(streamed, streamed_stages))
+    return out
+
+
+def _vs_prev_bench(streamed_eps: float, stages: dict) -> dict:
+    """Compare this run's streamed rate + per-stage seconds against the
+    newest ``BENCH_r*.json`` next to bench.py (the driver's trajectory
+    files), so a stage regression is visible IN the bench output instead
+    of requiring a by-hand diff of two trajectory files. Older trajectory
+    entries predate the stages breakdown — missing pieces just elide."""
+    import glob
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    runs = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    if not runs:
+        return {}
+    try:
+        with open(runs[-1]) as f:
+            parsed = json.load(f).get("parsed") or {}
+    except (OSError, ValueError):
+        return {}
+    # the driver runs bench.py bare (e2e nested under "e2e"); a by-hand
+    # `--e2e` run IS the e2e dict at top level
+    e2e = parsed.get("e2e") or parsed
+    prev = (e2e.get("streamed") if isinstance(e2e, dict) else None) or {}
+    if not prev.get("value"):
+        return {}
+    out: dict = {"prev_run": os.path.basename(runs[-1]),
+                 "vs_prev": round(streamed_eps / prev["value"], 3)}
+    prev_stages = prev.get("stages") or {}
+    delta = {k: round(v - prev_stages[k], 3)
+             for k, v in stages.items()
+             if isinstance(v, (int, float)) and k in prev_stages
+             and isinstance(prev_stages[k], (int, float))}
+    if delta:
+        out["stages_delta_s"] = delta
+    return out
 
 
 def _gen_serve_rows(n_rows: int, nnz_per_row: int, id_space: int,

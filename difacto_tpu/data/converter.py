@@ -20,6 +20,7 @@ Two rec upgrades over the reference's CRB converter:
 from __future__ import annotations
 
 import logging
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 
@@ -33,6 +34,102 @@ from .rec import write_rec_block
 from .rowblock import RowBlock, RowBlockBuilder
 
 log = logging.getLogger("difacto_tpu")
+
+
+@dataclass
+class _ConvertSpec:
+    """Everything a convert worker process needs to convert ITS byte-range
+    part of the input into member files — plain picklable values only."""
+    data_in: str
+    data_format: str
+    out_dir: str
+    member_suffix: str
+    member_rows: int
+    rec_localize: bool
+    rec_compress: bool
+    chunk_bytes: int
+    n_parts: int
+
+
+def _convert_member_arrays(blk: RowBlock, localize: bool):
+    if localize:
+        cblk, uniq, _ = compact(blk)
+        return cblk, uniq
+    return blk, None
+
+
+def convert_part_iter(spec: _ConvertSpec, part: int):
+    """Process-pool ``make_iter`` for the parallel text->rec convert: parse
+    this part's byte range, slice into member-row blocks, localize and
+    write each member directly from the worker (the OUTPUT is the file
+    set, so nothing heavy rides the ring — just per-member stats).
+    Deterministic per part (fixed byte ranges, fixed member naming), so
+    the pool's retry/straggler re-issue contract holds: a re-run rewrites
+    the same members through atomic renames."""
+    from .parsers import get_parser
+    from .reader import _byte_ranges, _iter_text_chunks, expand_uri
+
+    if spec.data_format.lower() == "rec":
+        blocks = iter(Reader(spec.data_in, "rec", part, spec.n_parts,
+                             chunk_bytes=spec.chunk_bytes))
+
+        def timed_blocks():
+            it = blocks
+            while True:
+                t0 = time.perf_counter()
+                blk = next(it, None)
+                dt = time.perf_counter() - t0
+                if blk is None:
+                    return
+                yield blk, dt
+    else:
+        parse = get_parser(spec.data_format)
+        files, sizes = expand_uri(spec.data_in, with_sizes=True)
+        ranges = _byte_ranges(files, sizes, part, spec.n_parts)
+
+        def timed_blocks():
+            for path, b, e in ranges:
+                for ch in _iter_text_chunks(path, b, e, spec.chunk_bytes):
+                    t0 = time.perf_counter()
+                    blk = parse(ch)
+                    dt = time.perf_counter() - t0
+                    if blk.size:
+                        yield blk, dt
+
+    builder = RowBlockBuilder()
+    pending_parse = 0.0
+    n_member = 0
+    bs = spec.member_rows
+
+    def write_member(blk: RowBlock, parse_s: float):
+        nonlocal n_member
+        path = stream.join(
+            spec.out_dir, f"part-{part:03d}-{n_member:05d}"
+                          f"{spec.member_suffix}")
+        t0 = time.perf_counter()
+        cblk, uniq = _convert_member_arrays(blk, spec.rec_localize)
+        write_rec_block(path, cblk, uniq=uniq,
+                        compress=spec.rec_compress)
+        n_member += 1
+        return ("member", blk.size, stream.getsize(path), parse_s,
+                time.perf_counter() - t0)
+
+    for blk, dt in timed_blocks():
+        if bs <= 0:  # -1: one member per read chunk
+            yield write_member(blk, dt)
+            continue
+        pending_parse += dt
+        start = 0
+        while start < blk.size:
+            take = min(bs - builder.num_rows, blk.size - start)
+            builder.push(blk.slice(start, start + take))
+            start += take
+            if builder.num_rows >= bs:
+                yield write_member(builder.build(), pending_parse)
+                pending_parse = 0.0
+                builder.clear()
+    if builder.num_rows:
+        yield write_member(builder.build(), pending_parse)
 
 
 @dataclass
@@ -55,12 +152,25 @@ class ConverterParam(Param):
     # training config auto-aligns members (see rec_batch_size)
     batch_size: int = 0
     convert_threads: int = 0  # 0 = auto
-    # zlib-compress rec members. Default OFF: the rec format exists to
-    # make STREAMING fast (the reference picked LZ4 for the same reason,
-    # src/data/compressed_row_block.h:20-142) and zlib decompress
-    # measured 68% of the streamed-epoch host-pack pass (1.32 of 1.93 s
-    # per 600k rows, docs/perf_notes.md "the streamed regime");
-    # uncompressed members are ~2.6x larger but read at page-cache speed
+    # worker PROCESSES for the text->rec convert; 0 = auto (process
+    # workers on hosts with >= 4 cores, threads below — same heuristic as
+    # the learner's producer_mode), 1 = force the in-process threaded
+    # path. Parallel convert shards the input by byte range across the
+    # existing ProcessProducerPool (each worker parses + localizes +
+    # writes its members directly), so the one-time convert stops being
+    # bounded by one interpreter (it measured 146k ex/s single-process —
+    # slower than training itself, ISSUE 7).
+    convert_procs: int = 0
+    # member encoding: "rec2" (default — the zero-copy page-aligned
+    # framing of rec2.py, mmap'd at read time) or "npz" (legacy v1)
+    rec_encoding: str = "rec2"
+    # zlib-compress rec members (npz encoding only). Default OFF: the rec
+    # format exists to make STREAMING fast (the reference picked LZ4 for
+    # the same reason, src/data/compressed_row_block.h:20-142) and zlib
+    # decompress measured 68% of the streamed-epoch host-pack pass (1.32
+    # of 1.93 s per 600k rows, docs/perf_notes.md "the streamed regime");
+    # uncompressed members are ~2.6x larger but read at page-cache speed.
+    # rec2 members are always raw.
     rec_compress: bool = False
 
 
@@ -72,7 +182,12 @@ DEFAULT_MEMBER_ROWS = 8192
 
 class Converter:
     def __init__(self) -> None:
+        import threading
         self.param: ConverterParam | None = None
+        # filled by run(): rows, eps, parse_s, write_s, procs, members —
+        # the per-stage convert accounting bench.py reports (convert.*)
+        self.stats: dict = {}
+        self._stage_lock = threading.Lock()
 
     def member_rows(self) -> int:
         """Resolved rows-per-member (see ConverterParam.rec_batch_size):
@@ -93,13 +208,92 @@ class Converter:
         if self.param.data_out_format not in ("libsvm", "rec"):
             raise ValueError(
                 f"unknown output format: {self.param.data_out_format}")
+        if self.param.rec_encoding not in ("rec2", "npz"):
+            raise ValueError(
+                f"unknown rec_encoding: {self.param.rec_encoding!r} "
+                "(rec2|npz)")
         return remain
 
+    def member_suffix(self) -> str:
+        from .rec2 import SUFFIX
+        return SUFFIX if self.param.rec_encoding == "rec2" else ".npz"
+
+    def _acc_stage(self, key: str, dt: float) -> None:
+        # summed across parse/write worker threads (tiny critical section)
+        with self._stage_lock:
+            self.stats[key] = round(self.stats.get(key, 0.0) + dt, 4)
+
+    def resolve_procs(self) -> int:
+        """Worker-process count for the rec convert. Explicit wins; auto
+        (0) engages processes only when cores can actually overlap (the
+        learner's producer_mode heuristic) and the output is a single
+        part (part_size splitting stays on the threaded path — its
+        rollover bookkeeping is inherently serial)."""
+        import os
+        p = self.param
+        if p.part_size > 0 or p.data_out_format != "rec":
+            return 1
+        if p.convert_procs > 0:
+            return p.convert_procs
+        ncpu = os.cpu_count() or 1
+        return min(ncpu, 8) if ncpu >= 4 else 1
+
     def run(self) -> None:
+        t0 = time.perf_counter()
         if self.param.data_out_format == "rec":
-            self._run_rec()
+            procs = self.resolve_procs()
+            if procs > 1:
+                self._run_rec_parallel(procs)
+            else:
+                self._run_rec()
         else:
             self._run_libsvm()
+        self.stats["convert_s"] = round(time.perf_counter() - t0, 3)
+        self.stats["rows"] = self.num_rows
+        if self.stats["convert_s"] > 0:
+            self.stats["eps"] = round(
+                self.num_rows / self.stats["convert_s"], 1)
+
+    def _run_rec_parallel(self, procs: int) -> None:
+        """Parallel text->rec convert across the existing
+        ProcessProducerPool (ISSUE 7 satellite): the input is sharded by
+        byte range over ``procs`` worker processes, each parsing +
+        localizing + writing its own members (named ``part-PPP-NNNNN``),
+        so the one-time convert scales with cores instead of being
+        pinned to one interpreter. Members stay batch-aligned within
+        each part; only each part's tail member runs short — the same
+        shape the ``part_size`` splitter always produced."""
+        import functools
+
+        from .producer_pool import ProcessProducerPool
+        p = self.param
+        out_dir = self._open_rec_part(0, False)
+        spec = _ConvertSpec(
+            data_in=p.data_in, data_format=p.data_format,
+            out_dir=out_dir, member_suffix=self.member_suffix(),
+            member_rows=self.member_rows(),
+            rec_localize=p.rec_localize, rec_compress=p.rec_compress,
+            chunk_bytes=min(int(p.chunk_size * (1 << 20)), 32 << 20),
+            n_parts=procs)
+        log.info("reading data from %s in %s format (%d convert workers)",
+                 p.data_in, p.data_format, procs)
+        pool = ProcessProducerPool(
+            procs, functools.partial(convert_part_iter, spec),
+            n_workers=procs, depth=8, slot_bytes=1 << 20)
+        nrows = members = out_bytes = 0
+        parse_s = write_s = 0.0
+        for _, item in pool:
+            _, rows, nbytes, p_s, w_s = item
+            nrows += rows
+            members += 1
+            out_bytes += nbytes
+            parse_s += p_s
+            write_s += w_s
+        log.info("done. written %d examples", nrows)
+        self.num_rows = nrows
+        self.stats.update(procs=procs, members=members,
+                          out_bytes=out_bytes, parse_s=round(parse_s, 3),
+                          write_s=round(write_s, 3))
 
     # ------------------------------------------------------------- rec
     def _parsed_blocks(self, threads: int):
@@ -124,10 +318,16 @@ class Converter:
             for path, b, e in _byte_ranges(files, sizes, 0, 1):
                 yield from _iter_text_chunks(path, b, e, chunk_bytes)
 
+        def timed_parse(ch):
+            t0 = time.perf_counter()
+            blk = parse(ch)
+            self._acc_stage("parse_s", time.perf_counter() - t0)
+            return blk
+
         with ThreadPoolExecutor(max_workers=threads) as ex:
             futs: deque = deque()
             for ch in chunks():
-                futs.append(ex.submit(parse, ch))
+                futs.append(ex.submit(timed_parse, ch))
                 while len(futs) >= 2 * threads:
                     blk = futs.popleft().result()
                     if blk.size:
@@ -168,6 +368,7 @@ class Converter:
         out_dir = self._open_rec_part(ipart, split)
 
         def write_member(path: str, blk: RowBlock) -> int:
+            t0 = time.perf_counter()
             if p.rec_localize:
                 cblk, uniq, _ = compact(blk)
                 write_rec_block(path, cblk, uniq=uniq,
@@ -175,6 +376,7 @@ class Converter:
             else:
                 write_rec_block(path, blk, compress=p.rec_compress)
             sz = stream.getsize(path)
+            self._acc_stage("write_s", time.perf_counter() - t0)
             with written_lock:
                 written[0] += sz
             return sz
@@ -211,7 +413,8 @@ class Converter:
                     nblk = 0
                     written[0] = 0
                     out_dir = self._open_rec_part(ipart, split)
-                path = stream.join(out_dir, f"part-{nblk:05d}.npz")
+                path = stream.join(out_dir,
+                                   f"part-{nblk:05d}{self.member_suffix()}")
                 futures.append(ex.submit(write_member, path, blk))
                 nblk += 1
                 nrows += blk.size

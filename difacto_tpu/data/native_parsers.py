@@ -23,7 +23,11 @@ def parse_libsvm_native(chunk: bytes) -> RowBlock:
         return parse_libsvm(chunk)
 
     max_rows = chunk.count(b"\n") + 2
-    max_nnz = chunk.count(b":") + 1
+    # implicit-value tokens ("idx" == "idx:1") carry no ':', so budget by
+    # token count instead: tokens are separated by >= 1 whitespace char
+    # and each row owns one label token, so features <= separators + 1
+    max_nnz = (chunk.count(b" ") + chunk.count(b"\t") + chunk.count(b"\n")
+               + chunk.count(b"\r") + 2)
     labels = np.empty(max_rows, dtype=REAL_DTYPE)
     offset = np.empty(max_rows + 1, dtype=np.int64)
     index = np.empty(max_nnz, dtype=FEAID_DTYPE)

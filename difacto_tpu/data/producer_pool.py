@@ -189,6 +189,9 @@ class OrderedProducerPool:
 # --------------------------------------------------------------------------
 
 _STOP_ITER = object()
+# most items a worker coalesces into one ring slot (bounds both the
+# group's decode burst on the consumer and the per-slot latency)
+_MAX_COALESCE = 16
 
 
 def _pp_worker_main(worker_id: int, make_iter_bytes: bytes, ring_desc,
@@ -214,7 +217,7 @@ def _pp_worker_main(worker_id: int, make_iter_bytes: bytes, ring_desc,
     os.environ.update(env or {})
     import traceback
 
-    from .shm_ring import ShmRing, SlotOverflow
+    from .shm_ring import ShmRing, SlotOverflow, _align, encode_item
     make_iter = pickle.loads(make_iter_bytes)
     from ..obs import REGISTRY, proc, trace
     ring_wait_c = REGISTRY.counter(
@@ -242,6 +245,89 @@ def _pp_worker_main(worker_id: int, make_iter_bytes: bytes, ring_desc,
                 return
             _, part, gen, start = cmd
             try:
+                # multi-part-per-slot coalescing: items far smaller than
+                # a slot share one (header count > 1), so small batches
+                # pay one lease + one consumer wakeup per GROUP and
+                # ring_wait amortizes. Items over half the usable budget
+                # ship immediately — coalescing them would delay the
+                # in-flight batch by a whole pack cycle for nothing.
+                budget = ring.slot_bytes * 3 // 4
+                pend: list = []  # [(seq, item, pack_dt, span)]
+                pend_bytes = 0
+
+                def est_bytes(it_) -> int:
+                    arrays: list = []
+                    encode_item(it_, arrays)
+                    return sum(_align(a.nbytes) for a in arrays) + 4096
+
+                def lease_slot(seq):
+                    t_wait = time.perf_counter()
+                    s = None
+                    with trace.span("producer.ring_wait", part=part,
+                                    seq=seq):
+                        while not stop_ev.is_set():  # backpressure point
+                            try:
+                                s = free_q.get(timeout=0.1)
+                                break
+                            except queue.Empty:
+                                continue
+                    wait_dt = time.perf_counter() - t_wait
+                    ring_wait_c.inc(wait_dt)
+                    ring_wait_h.observe(wait_dt)
+                    return s
+
+                def send_single(seq, it_, dt, span, slot=None) -> bool:
+                    if slot is None:
+                        slot = lease_slot(seq)
+                        if slot is None:
+                            return False  # stopping
+                    try:
+                        ring.write(slot, it_, part=part, seq=seq, gen=gen,
+                                   span=span)
+                        done_q.put(("item", worker_id, part, gen, seq,
+                                    slot, None, dt, 1))
+                    except SlotOverflow:
+                        # oversize item: fall back to the pickled channel
+                        # — slower, never wrong. The unused slot rides
+                        # the message for the CONSUMER to release: a
+                        # worker writing to free_q would share that
+                        # queue's write lock with the consumer, and a
+                        # kill while holding it would wedge the
+                        # consumer's releases.
+                        done_q.put(("ovf", worker_id, part, gen, seq,
+                                    slot, pickle.dumps(it_), dt, 1))
+                    return True
+
+                def flush() -> bool:
+                    nonlocal pend, pend_bytes
+                    if not pend:
+                        return True
+                    group, pend = pend, []
+                    pend_bytes = 0
+                    if len(group) == 1:
+                        return send_single(*group[0])
+                    seq0, _, _, span0 = group[0]
+                    slot = lease_slot(seq0)
+                    if slot is None:
+                        return False
+                    try:
+                        ring.write(slot, [g[1] for g in group], part=part,
+                                   seq=seq0, gen=gen, span=span0,
+                                   count=len(group))
+                        done_q.put(("item", worker_id, part, gen, seq0,
+                                    slot, None,
+                                    sum(g[2] for g in group), len(group)))
+                        return True
+                    except SlotOverflow:
+                        # the estimate undercounted (meta overhead):
+                        # degrade to one item per slot, reusing the lease
+                        if not send_single(*group[0], slot=slot):
+                            return False
+                        for g in group[1:]:
+                            if not send_single(*g):
+                                return False
+                        return True
+
                 it = itertools.islice(make_iter(part), start, None)
                 n = start
                 while True:
@@ -251,36 +337,21 @@ def _pp_worker_main(worker_id: int, make_iter_bytes: bytes, ring_desc,
                         break
                     pack_dt = time.perf_counter() - t0
                     span = trace.last_span_id()
-                    slot = None
-                    t_wait = time.perf_counter()
-                    with trace.span("producer.ring_wait", part=part,
-                                    seq=n):
-                        while not stop_ev.is_set():  # backpressure point
-                            try:
-                                slot = free_q.get(timeout=0.1)
-                                break
-                            except queue.Empty:
-                                continue
-                    wait_dt = time.perf_counter() - t_wait
-                    ring_wait_c.inc(wait_dt)
-                    ring_wait_h.observe(wait_dt)
-                    if slot is None:
-                        return  # stopping
-                    try:
-                        ring.write(slot, item, part=part, seq=n, gen=gen,
-                                   span=span)
-                        done_q.put(("item", worker_id, part, gen, n, slot,
-                                    None, pack_dt))
-                    except SlotOverflow:
-                        # oversize item: fall back to the pickled channel
-                        # — slower, never wrong. The unused slot rides the
-                        # message for the CONSUMER to release: a worker
-                        # writing to free_q would share that queue's write
-                        # lock with the consumer, and a kill while holding
-                        # it would wedge the consumer's releases.
-                        done_q.put(("ovf", worker_id, part, gen, n, slot,
-                                    pickle.dumps(item), pack_dt))
+                    sz = est_bytes(item)
+                    if sz > budget // 2:
+                        if not flush() or not send_single(n, item,
+                                                          pack_dt, span):
+                            return
+                    else:
+                        if pend and (pend_bytes + sz > budget
+                                     or len(pend) >= _MAX_COALESCE):
+                            if not flush():
+                                return
+                        pend.append((n, item, pack_dt, span))
+                        pend_bytes += sz
                     n += 1
+                if not flush():
+                    return
                 if not stop_ev.is_set():
                     done_q.put(("end", worker_id, part, gen, n))
                     publish()
@@ -440,7 +511,7 @@ class ProcessProducerPool:
                 return
             _, w, part, g = msg[:4]
             if kind in ("item", "ovf"):
-                _, _, _, _, seq, slot, blob, pack_dt = msg
+                _, _, _, _, seq, slot, blob, pack_dt, _cnt = msg
                 self.pack_s += pack_dt
                 if kind == "ovf":
                     # pickled fallback: the leased-but-unused slot comes
@@ -453,18 +524,24 @@ class ProcessProducerPool:
                 span = 0
                 if slot >= 0:
                     from .shm_ring import SlotLease
-                    _, _, _, span = self._ring.read_header(slot)
+                    _, _, _, span, cnt = self._ring.read_header(slot)
                     item, _, _, _ = self._ring.read(slot)
-                    lease = SlotLease(self._ring, slot)
+                    # a multi-item slot fans out into per-item entries
+                    # sharing one refcounted lease: the slot recycles
+                    # when the LAST item's consumer is done with it
+                    subs = item if cnt > 1 else [item]
+                    handles = SlotLease(self._ring, slot).split(len(subs))
                 else:
-                    item, lease = pickle.loads(blob), None
+                    subs = [pickle.loads(blob)]
+                    handles = [None]
                     self.overflow_items += 1
                     self._obs.counter(
                         "producer_overflow_total",
                         "items too large for a ring slot (pickled "
                         "fallback)").inc()
-                accepted[part] += 1
-                buffers[part].append((item, lease, span))
+                accepted[part] += len(subs)
+                for it_, h in zip(subs, handles):
+                    buffers[part].append((it_, h, span))
             elif kind == "end":
                 if g == gen[part]:
                     complete[part] = True

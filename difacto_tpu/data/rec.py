@@ -6,10 +6,19 @@ produced by ``task=convert`` (src/reader/converter.h:41-124). Feeding TPU
 chips from text on a single-core host is hopeless, so the same design carries
 over: parse text once, write compressed binary shards, stream those.
 
-Format: a ``<name>.rec`` directory (or explicit file list) of ``.npz``
-members, one compressed CSR block each, arrays: offset/label/index[/value]
-[/weight]. Sharding for (part_idx, num_parts) is by whole members, weighted
-by compressed size — the unit of work-stealing, like recordio parts.
+Format: a ``<name>.rec`` directory (or explicit file list) of members,
+one CSR block each, arrays: offset/label/index[/value][/weight]. Two
+member encodings coexist, dispatched on extension:
+
+- ``.rec2`` (default for new writes) — the raw page-aligned zero-copy
+  framing of rec2.py: readers ``mmap`` the member and get
+  ``np.frombuffer`` views, no decompress, no archive walk, typed
+  :class:`~.rec2.RecCorrupt` on torn/bit-flipped files;
+- ``.npz`` (legacy v1) — numpy archives, still read transparently so
+  existing caches keep working (``task=convert`` re-encodes them).
+
+Sharding for (part_idx, num_parts) is by whole members, weighted by
+on-disk size — the unit of work-stealing, like recordio parts.
 
 **Pre-localized members** additionally carry ``uniq``: the member's sorted
 distinct *reversed* feature ids (the Localizer output, data/localizer.py),
@@ -26,13 +35,21 @@ from typing import Iterator, List, Optional, Tuple
 import numpy as np
 
 from ..utils import stream
+from .rec2 import SUFFIX as REC2_SUFFIX
+from .rec2 import read_rec2, write_rec2
 from .rowblock import RowBlock
+
+# member extensions the rec cache readers accept, in either encoding
+MEMBER_SUFFIXES = (REC2_SUFFIX, ".npz")
 
 
 def write_rec_block(path: str, blk: RowBlock, compress: bool = True,
                     uniq: Optional[np.ndarray] = None) -> None:
     """``uniq`` marks a pre-localized member: blk.index must be uint32
-    positions into uniq (sorted reversed ids)."""
+    positions into uniq (sorted reversed ids). The encoding follows the
+    path's extension: ``.rec2`` = the zero-copy framing (rec2.py,
+    ``compress`` ignored — raw sections read at page-cache speed),
+    ``.npz`` = the legacy archive."""
     arrays = dict(offset=blk.offset, label=blk.label, index=blk.index)
     if uniq is not None:
         arrays["uniq"] = uniq
@@ -41,11 +58,25 @@ def write_rec_block(path: str, blk: RowBlock, compress: bool = True,
         arrays["value"] = blk.value
     if blk.weight is not None:
         arrays["weight"] = blk.weight
+    if path.endswith(REC2_SUFFIX):
+        write_rec2(path, arrays)
+        return
     stream.save_npz(path, compress=compress, **arrays)
 
 
 def read_rec_block_ex(path: str) -> Tuple[RowBlock, Optional[np.ndarray]]:
-    """(block, uniq-or-None); uniq != None means index is localized."""
+    """(block, uniq-or-None); uniq != None means index is localized.
+    Dispatches on the member extension; rec2 members come back as
+    zero-copy mmap views."""
+    if path.endswith(REC2_SUFFIX):
+        z2 = read_rec2(path)
+        return RowBlock(
+            offset=z2["offset"],
+            label=z2["label"],
+            index=z2["index"],
+            value=z2.get("value"),
+            weight=z2.get("weight"),
+        ), z2.get("uniq")
     with stream.load_npz(path) as z:
         blk = RowBlock(
             offset=z["offset"],
@@ -69,15 +100,16 @@ def read_rec_block(path: str) -> RowBlock:
 
 
 def rec_members(files: List[str], sizes=None) -> List[tuple]:
-    """Resolve to [(member, size)] .npz members only — stray files (.tmp from
-    an interrupted writer, READMEs) in a cache dir must not reach np.load.
-    ``sizes`` parallel to ``files`` avoids a remote stat per member."""
+    """Resolve to [(member, size)] known member encodings only — stray
+    files (.tmp from an interrupted writer, READMEs) in a cache dir must
+    not reach the block readers. ``sizes`` parallel to ``files`` avoids a
+    remote stat per member."""
     out: List[tuple] = []
     for i, f in enumerate(files):
         if stream.isdir(f):
             out.extend((m, sz) for m, sz in stream.listdir_files(f)
-                       if m.endswith(".npz"))
-        elif f.endswith(".npz"):
+                       if m.endswith(MEMBER_SUFFIXES))
+        elif f.endswith(MEMBER_SUFFIXES):
             sz = sizes[i] if sizes is not None and sizes[i] >= 0 \
                 else stream.getsize(f)
             out.append((f, sz))
@@ -102,16 +134,20 @@ def iter_rec_blocks(files: List[str], part_idx: int, num_parts: int,
 
 
 class RecWriter:
-    """Write a stream of RowBlocks into a .rec directory of npz shards."""
+    """Write a stream of RowBlocks into a .rec directory of member shards
+    (rec2 framing by default; ``member_suffix='.npz'`` keeps v1)."""
 
-    def __init__(self, out_dir: str, compress: bool = True):
+    def __init__(self, out_dir: str, compress: bool = True,
+                 member_suffix: str = REC2_SUFFIX):
         self.out_dir = out_dir
         self.compress = compress
+        self.member_suffix = member_suffix
         self._n = 0
         stream.makedirs(out_dir)
 
     def write(self, blk: RowBlock) -> None:
-        path = stream.join(self.out_dir, f"part-{self._n:05d}.npz")
+        path = stream.join(self.out_dir,
+                           f"part-{self._n:05d}{self.member_suffix}")
         write_rec_block(path, blk, self.compress)
         self._n += 1
 

@@ -17,14 +17,18 @@ Slot layout (one slot = ``slot_bytes`` of the segment)::
     [pickled meta][ meta_len u32 | part u32 | seq u32 |
                     gen u32 | span u32 | payload u64 ]  tail header
 
-The tail header carries the item identity (part id, seq no, attempt
-generation), the PRODUCER'S trace span id (``span`` — the obs/trace.py
+The tail header carries the item identity (part id, seq no of the FIRST
+item, attempt generation), an item COUNT (a producer may coalesce
+several small consecutive items of one part into a single slot — the
+multi-part-per-slot packing that amortizes slot leases and ring_wait
+when payloads run far below slot_bytes; the items then occupy seq ..
+seq+count-1), the PRODUCER'S trace span id (``span`` — the obs/trace.py
 span that packed this item, so the consumer's unpack/step spans can
 point at the exact producer span that built their batch across the
 process boundary) and the pickled meta — the item's structure with every array
 replaced by a (shape, dtype, offset) placeholder — so a slot is fully
-self-describing: the consumer rebuilds the exact item object from the
-slot alone.
+self-describing: the consumer rebuilds the exact item object (for
+count > 1: the list of items) from the slot alone.
 
 Lease/release + backpressure: free slot ids travel through per-owner
 multiprocessing queues (one queue per worker, slots pre-partitioned), so
@@ -53,8 +57,8 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-# meta_len, part, seq, gen, producer span id, payload_bytes
-_HEADER = struct.Struct("<IIIIIQ")
+# meta_len, part, seq, gen, item count, producer span id, payload_bytes
+_HEADER = struct.Struct("<IIIIIIQ")
 _ALIGN = 64
 
 # live rings created by THIS process, for the atexit safety net
@@ -142,19 +146,61 @@ class SlotLease:
     arrays VIEW the slot's shared memory, so the slot must not return to
     the ring until the consumer is done with them (for the learner: until
     the device transfer/step consuming the views has completed).
-    ``release`` is idempotent."""
+    ``release`` is idempotent.
 
-    __slots__ = ("_ring", "slot", "_released")
+    A multi-item slot (header count > 1) is shared by every item it
+    carries: ``split(k)`` hands out k child handles, each independently
+    idempotent, and the slot returns to the ring when the LAST child
+    releases."""
+
+    __slots__ = ("_ring", "slot", "_refs", "_mu", "_released")
 
     def __init__(self, ring: "ShmRing", slot: int):
         self._ring = ring
         self.slot = slot
+        self._refs = 1
         self._released = False
+        self._mu = threading.Lock()
+
+    def split(self, k: int):
+        """k per-item child handles sharing this slot (k >= 1). The
+        parent's own reference transfers to the children — callers
+        release only the children afterwards."""
+        with self._mu:
+            self._refs += k - 1
+        self._released = True  # the children own the slot now
+        return [_LeaseShare(self) for _ in range(k)]
+
+    def _dec(self) -> None:
+        with self._mu:
+            self._refs -= 1
+            last = self._refs == 0
+        if last:
+            self._ring.release(self.slot)
 
     def release(self) -> None:
         if not self._released:
             self._released = True
-            self._ring.release(self.slot)
+            self._dec()
+
+
+class _LeaseShare:
+    """One item's handle on a shared multi-item slot (idempotent)."""
+
+    __slots__ = ("_parent", "_released")
+
+    def __init__(self, parent: SlotLease):
+        self._parent = parent
+        self._released = False
+
+    @property
+    def slot(self) -> int:
+        return self._parent.slot
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._parent._dec()
 
 
 class ShmRing:
@@ -217,9 +263,11 @@ class ShmRing:
 
     # ----------------------------------------------------------- write
     def write(self, slot: int, item: Any, part: int, seq: int,
-              gen: int, span: int = 0) -> None:
+              gen: int, span: int = 0, count: int = 1) -> None:
         """Encode ``item`` into ``slot``. ``span`` is the producer-side
-        trace span id riding the header (0 = tracing off). Raises
+        trace span id riding the header (0 = tracing off); ``count`` > 1
+        marks a multi-item slot (``item`` is then the LIST of coalesced
+        items, occupying seq .. seq+count-1). Raises
         :class:`SlotOverflow` (leaving the slot reusable) when it does
         not fit."""
         arrays: List[np.ndarray] = []
@@ -245,24 +293,27 @@ class ShmRing:
         end = base + self.slot_bytes
         buf[end - _HEADER.size - len(meta):end - _HEADER.size] = meta
         _HEADER.pack_into(buf, end - _HEADER.size, len(meta), part, seq,
-                          gen, span & 0xFFFFFFFF, off)
+                          gen, count, span & 0xFFFFFFFF, off)
 
     # ------------------------------------------------------------ read
-    def read_header(self, slot: int) -> Tuple[int, int, int, int]:
-        """(part, seq, gen, producer_span) without decoding the item —
-        the consumer's cross-process span linkage (obs/trace.py)."""
+    def read_header(self, slot: int) -> Tuple[int, int, int, int, int]:
+        """(part, seq, gen, producer_span, count) without decoding the
+        item — the consumer's cross-process span linkage (obs/trace.py)
+        plus the multi-item count."""
         end = (slot + 1) * self.slot_bytes
-        _, part, seq, gen, span, _ = _HEADER.unpack_from(
+        _, part, seq, gen, count, span, _ = _HEADER.unpack_from(
             self._shm.buf, end - _HEADER.size)
-        return part, seq, gen, span
+        return part, seq, gen, span, count
 
     def read(self, slot: int) -> Tuple[Any, int, int, int]:
         """(item, part, seq, gen) — the item's arrays are zero-copy views
-        into the slot; hold the lease until done with them."""
+        into the slot; hold the lease until done with them. For a
+        multi-item slot (header count > 1) ``item`` is the list of
+        items."""
         base = slot * self.slot_bytes
         end = base + self.slot_bytes
         buf = self._shm.buf
-        meta_len, part, seq, gen, _span, _ = _HEADER.unpack_from(
+        meta_len, part, seq, gen, _count, _span, _ = _HEADER.unpack_from(
             buf, end - _HEADER.size)
         spec, placements = pickle.loads(
             bytes(buf[end - _HEADER.size - meta_len:end - _HEADER.size]))

@@ -1892,9 +1892,44 @@ class SGDLearner(Learner):
                     jax.block_until_ready(fence)
                 lease.release()
 
+        # double-buffered H2D staging (ISSUE 7): a "ready" item's packed
+        # buffers are copied to the device the moment they arrive
+        # (_stage_payload — an async enqueue on accelerator backends)
+        # but its STEP dispatches one iteration later, so batch k+1's
+        # host->device transfer rides under batch k's device step
+        # instead of serializing in front of its own. The one-deep
+        # lookahead holds (part, staged item, ring lease, producer span).
+        lookahead: "collections.deque" = collections.deque()
+
+        def dispatch_entry(entry) -> None:
+            e_part, e_item, e_lease, e_span = entry
+            n_before = len(pending)
+            if trace.active():
+                # consumer-side span pointing at the exact producer span
+                # that packed this batch (the id rode the ring slot
+                # header across the process boundary)
+                with trace.span("consumer.dispatch", part=e_part,
+                                producer_span=e_span):
+                    self._dispatch_item(job_type, e_item, push_cnt,
+                                        want_counts, job, dim_min,
+                                        pending, cache=cache, part=e_part)
+            else:
+                self._dispatch_item(job_type, e_item, push_cnt,
+                                    want_counts, job, dim_min, pending,
+                                    cache=cache, part=e_part)
+            if e_lease is not None:
+                fence = (pending[-1][1] if len(pending) > n_before
+                         else None)
+                inflight.append((e_lease, fence))
+                retire(keep=2)
+
         for i, item in pool:
             part = stream_parts[i]
             if part != cur_part:
+                # drain the lookahead so part-boundary rows and merges
+                # account every batch of the finished part
+                while lookahead:
+                    dispatch_entry(lookahead.popleft())
                 cur_part = part
                 if reports and self._row_due(job_type):
                     self._merge_pending(pending, prog)
@@ -1904,32 +1939,24 @@ class SGDLearner(Learner):
                                       auc=prog.auc)
             if use_process:
                 self._absorb_payload_caps(job, item)
-            n_before = len(pending)
-            if trace.active():
-                # consumer-side span pointing at the exact producer span
-                # that packed this batch (the id rode the ring slot
-                # header across the process boundary)
-                with trace.span("consumer.dispatch", part=cur_part,
-                                producer_span=(pool.last_producer_span
-                                               if use_process else 0)):
-                    self._dispatch_item(job_type, item, push_cnt,
-                                        want_counts, job, dim_min,
-                                        pending, cache=cache,
-                                        part=cur_part)
+            lease = pool.pop_lease() if use_process else None
+            span = pool.last_producer_span if use_process else 0
+            if item[0] == "ready":
+                staged = ("ready", item[1], self._stage_payload(item[2]))
+                lookahead.append((part, staged, lease, span))
+                while len(lookahead) > 1:
+                    dispatch_entry(lookahead.popleft())
             else:
-                self._dispatch_item(job_type, item, push_cnt, want_counts,
-                                    job, dim_min, pending, cache=cache,
-                                    part=cur_part)
-            if use_process:
-                lease = pool.pop_lease()
-                if lease is not None:
-                    fence = (pending[-1][1] if len(pending) > n_before
-                             else None)
-                    inflight.append((lease, fence))
-                    retire(keep=2)
+                # consumer-mapped paths (dictionary store, mesh) keep
+                # strict receive order: flush the staged batch first
+                while lookahead:
+                    dispatch_entry(lookahead.popleft())
+                dispatch_entry((part, item, lease, span))
             if len(pending) >= self._MERGE_CAP:
                 self._merge_pending(pending, prog)
                 pending = []
+        while lookahead:
+            dispatch_entry(lookahead.popleft())
         self._final_merge(job_type, pending, prog)
         retire(keep=0)
         # process mode: the workers' parse/pack/ring-wait seconds arrived
@@ -2113,13 +2140,38 @@ class SGDLearner(Learner):
         return self._pack_payload(cblk, n_uniq, padded, b_cap, dim_min,
                                   job, counts=counts)
 
+    def _stage_payload(self, payload):
+        """Issue a packed payload's host->device copies NOW (an async
+        enqueue on accelerator backends) and return the payload with
+        device arrays in place of the numpy ones — the staging half of
+        _dispatch_prepared, split out so the consumer loop can
+        double-buffer: batch k+1's transfer overlaps batch k's step.
+        Counted into stage_seconds_total{stage=transfer}; the later
+        jnp.asarray in _dispatch_prepared is an identity on the staged
+        arrays."""
+        t0 = time.perf_counter()
+        if payload[0] == "panel_chunked":
+            (_, i32, f32, (ci, cl, cv), binary, b_cap, d2, u_cap) = payload
+            out = ("panel_chunked", jnp.asarray(i32), jnp.asarray(f32),
+                   (jnp.asarray(ci), jnp.asarray(cl),
+                    None if cv is None else jnp.asarray(cv)),
+                   binary, b_cap, d2, u_cap)
+        else:
+            layout, i32, f32, binary, b_cap, d2, u_cap = payload
+            out = (layout, jnp.asarray(i32), jnp.asarray(f32), binary,
+                   b_cap, d2, u_cap)
+        self._add_stage("transfer_s", time.perf_counter() - t0)
+        return out
+
     def _dispatch_prepared(self, job_type: int, blk, payload,
                            push_cnt: bool, want_counts: bool,
                            pending: list,
                            cache: Optional[_DeviceBatchCache],
                            part: int) -> None:
         """Stage + run one packed-payload batch (both store modes), then
-        hand the staged device buffers to the replay cache."""
+        hand the staged device buffers to the replay cache. Payload
+        arrays may be numpy (direct path) or already on device
+        (_stage_payload's double-buffered path)."""
         is_train = job_type == K_TRAINING
         t0 = time.perf_counter()
         if payload[0] == "panel_chunked":

@@ -66,15 +66,25 @@ extern "C" int difacto_parse_libsvm(
       if (*p == '-') return -1;  // strtoull would silently wrap negatives
       errno = 0;
       uint64_t idx = strtoull_l(p, &next, 10, c_locale());
-      if (next == p || next >= end || *next != ':') return -1;
-      if (errno == ERANGE) return -1;  // id > uint64 max must not clamp
-      p = next + 1;
-      // the value must start right after ':' — strtof skips whitespace
-      // (incl. '\n') and would otherwise swallow the next line's label
-      if (p >= end || isspace((unsigned char)*p)) return -1;
-      float val = strtof_l(p, &next, c_locale());
       if (next == p) return -1;
-      p = next;
+      if (errno == ERANGE) return -1;  // id > uint64 max must not clamp
+      float val = 1.0f;
+      if (next < end && *next == ':') {
+        p = next + 1;
+        // the value must start right after ':' — strtof skips whitespace
+        // (incl. '\n') and would otherwise swallow the next line's label
+        if (p >= end || isspace((unsigned char)*p)) return -1;
+        val = strtof_l(p, &next, c_locale());
+        if (next == p) return -1;
+        p = next;
+      } else if (next >= end || isspace((unsigned char)*next)) {
+        // implicit-value token "idx": value 1.0, same as "idx:1" — a
+        // chunk may mix implicit and explicit tokens freely (the value
+        // array stays consistent regardless of which form came first)
+        p = next;
+      } else {
+        return -1;  // trailing garbage glued to the index
+      }
       index[nnz] = idx;
       value[nnz] = val;
       if (val != 1.0f) has_value = 1;

@@ -26,7 +26,10 @@ run of a real cluster) arm through one environment variable:
   retry, never a client error), ``fleet.handoff`` (each replica's
   handoff step of a rolling restart, serve/fleet.py — ``err`` models a
   botched rotation and must abort the rollout with the incumbent still
-  serving).
+  serving), ``rec.read`` (every rec2 data-cache member open,
+  data/rec2.py — ``err`` is a failed disk read, ``truncate`` reads a
+  half-length view which the per-section CRCs must reject as a typed
+  ``RecCorrupt``, never a crash or silent short read).
 - ``kind`` — what happens when the fault fires:
     - ``err``      raise :class:`FaultInjected` (an OSError, so IO call
                    sites treat it exactly like a real IO failure);
