@@ -15,10 +15,27 @@ DATA_IN ?= data.txt
 DATA_FORMAT ?= criteo
 DATA_OUT ?= $(basename $(DATA_IN)).rec
 
-.PHONY: test smoke ci chaos fleet-chaos obs-report convert stream-bench
+.PHONY: test smoke ci lint lint-baseline chaos fleet-chaos obs-report \
+	convert stream-bench
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# difacto-lint (docs/static_analysis.md): compileall as a cheap syntax
+# pass, then the AST analyzer — concurrency/JAX/registry-drift rules
+# over difacto_tpu/, tools/, launch.py, bench.py. Exit 0 = no
+# unsuppressed, non-baselined findings. LINT_FORMAT=github emits PR
+# annotations (ci.yml uses it).
+LINT_FORMAT ?= text
+lint:
+	$(PY) -m compileall -q difacto_tpu tests tools bench.py launch.py
+	$(PY) tools/lint.py --format=$(LINT_FORMAT)
+
+# regenerate the grandfathered-finding baseline INTENTIONALLY (e.g.
+# after adding a rule that flags pre-existing code you are not fixing
+# in the same change) — never to silence a finding you just introduced
+lint-baseline:
+	$(PY) tools/lint.py --write-baseline
 
 # resilience suite alone (fault injection, drain, blue/green, takeover,
 # client failover — tests/test_chaos.py and friends)
@@ -40,7 +57,7 @@ smoke:
 	__graft_entry__.dryrun_multichip(8); \
 	print('entry + dryrun ok')"
 
-ci: test smoke
+ci: lint test smoke
 
 # human summary of a run's observability artifacts (docs/observability.md):
 #   make obs-report METRICS=run.metrics.jsonl TRACE=run.trace.json

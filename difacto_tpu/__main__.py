@@ -50,8 +50,9 @@ def _pred_routing_error(learner: str, kwargs: KWArgs) -> ValueError:
             if meta["learner"]:
                 produced = (f"; model_in={model_in!r} was produced by "
                             f"learner={meta['learner']!r}")
-        except Exception:  # unreadable/missing model: keep the base message
-            pass
+        except Exception as e:  # unreadable/missing model: keep the
+            # base message, but leave a trace for whoever debugs it
+            log.debug("model meta unreadable for %s: %s", model_in, e)
     return ValueError(
         f"task=pred runs the bucketed sgd predict executor and is not "
         f"implemented by learner={learner!r}{produced}. Batch-score sgd "

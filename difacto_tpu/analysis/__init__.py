@@ -1,0 +1,26 @@
+"""difacto-lint: an AST-based project analyzer (docs/static_analysis.md).
+
+The tree is ~16k lines of multiprocess/multithreaded Python whose
+correctness rests on conventions no generic tool checks: fault-point and
+metric names are free strings that must stay in sync with the chaos
+suite and the docs catalogs, ``#control`` lines must match on both ends
+of the wire, shm-ring leases and sockets must be released on every path,
+and the JAX hot loop silently miscompiles if a donated buffer is reused
+or a jitted closure captures mutable state. This package encodes those
+conventions as checkable rules — stdlib ``ast`` only, no new deps.
+
+Layout:
+
+- :mod:`core`       — rule framework: findings, ``# lint: ok(rule-id)``
+  inline suppressions, the checked-in baseline, output formats, exit
+  codes, the project index cross-file rules read.
+- :mod:`localrules` — single-file rules (thread lifecycle, lock
+  release, resource close, the monotonic-clock contract, broad
+  excepts, the three JAX tracing rules).
+- :mod:`crossrules` — project-wide registry-drift rules (fault points,
+  metric names, ``#control`` lines, config knobs).
+- :mod:`cli`        — ``python -m difacto_tpu.analysis`` /
+  ``tools/lint.py`` / ``make lint``.
+"""
+
+from .core import Finding, Project, all_rules, run_project  # noqa: F401

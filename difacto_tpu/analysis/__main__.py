@@ -1,0 +1,7 @@
+"""``python -m difacto_tpu.analysis`` -> the difacto-lint CLI."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,440 @@
+"""Single-file rules: concurrency hygiene, the monotonic-clock
+contract, exception discipline, and the three JAX tracing rules.
+
+Each rule is a function ``(SourceFile) -> list[Finding]`` registered
+with :func:`core.rule`. They share one parsed AST (with ``.parent``
+links) per file and a handful of helpers from :mod:`core`; none of them
+import anything outside the stdlib. Per-rule fixtures live in
+``tests/test_lint.py`` — every rule has at least one true-positive and
+one suppressed/negative fixture there.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import (Finding, SourceFile, call_name, dotted,
+                   enclosing_function, from_imports, import_aliases,
+                   node_key, rule, statement_of)
+
+
+def _walk_calls(tree) -> List[ast.Call]:
+    return [n for n in ast.walk(tree) if isinstance(n, ast.Call)]
+
+
+def _assign_key(call: ast.Call) -> Optional[str]:
+    """Key of the single name/attribute a call's value is bound to, or
+    None when unbound (bare expression, tuple target, nested use)."""
+    stmt = statement_of(call)
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+            and stmt.value is call:
+        return node_key(stmt.targets[0]) or None
+    if isinstance(stmt, ast.AnnAssign) and stmt.value is call:
+        return node_key(stmt.target) or None
+    return None
+
+
+def _method_calls_on(tree, key: str, methods: Set[str]) -> bool:
+    """Is there any ``<key>.m(...)`` call with m in methods?"""
+    for c in _walk_calls(tree):
+        if isinstance(c.func, ast.Attribute) and c.func.attr in methods \
+                and node_key(c.func.value) == key:
+            return True
+    return False
+
+
+def _in_withitem(node) -> bool:
+    """Is this expression (possibly wrapped, e.g. ``closing(...)``) the
+    context expression of a ``with`` statement?"""
+    cur = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        parent = getattr(cur, "parent", None)
+        if isinstance(parent, ast.withitem):
+            return True
+        cur = parent
+    return False
+
+
+# ---------------------------------------------------------------------------
+
+
+@rule("thread-daemon",
+      "threads must be created with daemon= or joined")
+def check_thread_daemon(sf: SourceFile) -> List[Finding]:
+    out = []
+    for call in _walk_calls(sf.tree):
+        cn = call_name(call)
+        if not (cn == "Thread" or cn.endswith(".Thread")):
+            continue
+        if any(kw.arg == "daemon" for kw in call.keywords):
+            continue
+        key = _assign_key(call)
+        if key and _method_calls_on(sf.tree, key, {"join"}):
+            continue
+        out.append(sf.finding(
+            "thread-daemon", call,
+            "thread created without daemon= and never joined — a "
+            "non-daemon thread blocks interpreter exit; pass "
+            "daemon=True or join() it on every path"))
+    return out
+
+
+@rule("lock-release",
+      "Lock.acquire() needs `with lock:` or finally: release()")
+def check_lock_release(sf: SourceFile) -> List[Finding]:
+    out = []
+    for call in _walk_calls(sf.tree):
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "acquire"):
+            continue
+        key = node_key(call.func.value)
+        if not key:
+            continue
+        # accept a matching `finally: release()` anywhere in the
+        # enclosing function — it may guard the acquire from an
+        # ancestor Try OR follow it as a sibling (`if not
+        # lock.acquire(timeout=...): return` then try/finally)
+        scope = enclosing_function(call) or sf.tree
+        released = False
+        for t in ast.walk(scope):
+            if not isinstance(t, ast.Try):
+                continue
+            for stmt in t.finalbody:
+                for c in _walk_calls(stmt):
+                    if isinstance(c.func, ast.Attribute) \
+                            and c.func.attr == "release" \
+                            and node_key(c.func.value) == key:
+                        released = True
+        if not released:
+            out.append(sf.finding(
+                "lock-release", call,
+                f"{key.lstrip('.')}.acquire() outside `with` without a "
+                f"finally: release() — an exception between acquire and "
+                f"release deadlocks every other holder"))
+    return out
+
+
+# resources whose open must pair with a close on every path
+_OPEN_EXACT = {"open", "io.open", "os.fdopen", "gzip.open",
+               "socket.socket", "socket.create_connection", "mmap.mmap"}
+_CLOSERS = {"close", "shutdown", "unlink", "release", "detach",
+            "terminate", "fileno"}  # fileno: fd handed to an owning wrapper
+
+
+def _is_opener(cn: str) -> bool:
+    return cn in _OPEN_EXACT or cn == "SharedMemory" \
+        or cn.endswith(".SharedMemory")
+
+
+def _name_escapes(scope, key: str, binder: ast.stmt) -> bool:
+    """Does the bound resource leave this scope (returned, yielded,
+    stored in a container/attribute, or passed to another call)? An
+    escaped resource is some other owner's to close."""
+    for n in ast.walk(scope):
+        if not (isinstance(n, ast.Name) and n.id == key
+                and isinstance(n.ctx, ast.Load)):
+            continue
+        parent = getattr(n, "parent", None)
+        if isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom,
+                               ast.Tuple, ast.List, ast.Dict, ast.Set)):
+            return True
+        if isinstance(parent, ast.Call) and n in parent.args:
+            return True
+        if isinstance(parent, ast.keyword):
+            return True
+        if isinstance(parent, (ast.Assign, ast.AnnAssign)) \
+            and getattr(parent, "value", None) is n \
+                and statement_of(parent) is not binder:
+            return True  # re-bound elsewhere: aliased, owner unclear
+        if isinstance(parent, ast.Subscript):
+            return True
+    return False
+
+
+@rule("resource-close",
+      "sockets/files/SharedMemory/mmap need `with` or a close on "
+      "every path")
+def check_resource_close(sf: SourceFile) -> List[Finding]:
+    out = []
+    for call in _walk_calls(sf.tree):
+        cn = call_name(call)
+        if not _is_opener(cn):
+            continue
+        if _in_withitem(call):
+            continue
+        stmt = statement_of(call)
+        if isinstance(stmt, ast.Return) or any(
+                isinstance(p, (ast.Yield, ast.YieldFrom))
+                for p in ast.walk(stmt)):
+            continue  # handed to the caller: theirs to close
+        key = _assign_key(call)
+        if key is None:
+            # not bound to a name: `f(open(p))` leaks the handle, a bare
+            # `socket.socket()` statement leaks the fd
+            out.append(sf.finding(
+                "resource-close", call,
+                f"{cn}(...) opened without a binding or `with` — the "
+                f"handle can never be closed"))
+            continue
+        if key.startswith("."):
+            # self/obj attribute: accept when the module closes that
+            # attribute somewhere (close()/stop() methods, __exit__)
+            if _method_calls_on(sf.tree, key, _CLOSERS):
+                continue
+        else:
+            scope = enclosing_function(call) or sf.tree
+            if _method_calls_on(scope, key, _CLOSERS):
+                continue
+            if _name_escapes(scope, key, stmt):
+                continue
+        out.append(sf.finding(
+            "resource-close", call,
+            f"{cn}(...) bound to {key.lstrip('.')} is never closed — "
+            f"use `with`, or close it in a finally/close() path"))
+    return out
+
+
+@rule("wall-clock",
+      "durations and deadlines must use time.monotonic()")
+def check_wall_clock(sf: SourceFile) -> List[Finding]:
+    time_aliases = import_aliases(sf.tree, "time")
+    time_members = {alias for alias, orig in
+                    from_imports(sf.tree, "time").items() if orig == "time"}
+    out = []
+    for call in _walk_calls(sf.tree):
+        f = call.func
+        hit = (isinstance(f, ast.Attribute) and f.attr == "time"
+               and isinstance(f.value, ast.Name)
+               and f.value.id in time_aliases) \
+            or (isinstance(f, ast.Name) and f.id in time_members)
+        if hit:
+            out.append(sf.finding(
+                "wall-clock", call,
+                "time.time() is wall clock: NTP steps/slew corrupt "
+                "durations and deadlines — use time.monotonic() (the "
+                "obs clock contract); a true timestamp-of-record may "
+                "suppress with `# lint: ok(wall-clock)`"))
+    return out
+
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+_COUNT_METHODS = {"inc", "observe", "set", "record_error", "record_shed",
+                  "set_exception", "print_exc", "format_exc",
+                  "count_swallowed"}
+
+
+def _handler_reports(handler: ast.ExceptHandler) -> bool:
+    """A broad handler is acceptable when the error is visibly routed
+    somewhere: re-raised, logged, counted, printed, formatted for a
+    result channel — or when the bound exception name is referenced at
+    all (captured into an err list, stuffed into a message, ...)."""
+    for n in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, ast.Call):
+            fn = n.func
+            if isinstance(fn, ast.Attribute) \
+                    and fn.attr in (_LOG_METHODS | _COUNT_METHODS):
+                return True
+            if isinstance(fn, ast.Name) and fn.id == "print":
+                return True
+        if handler.name and isinstance(n, ast.Name) \
+                and n.id == handler.name and isinstance(n.ctx, ast.Load):
+            return True
+    return False
+
+
+@rule("broad-except",
+      "broad excepts must log-and-count, re-raise, or narrow")
+def check_broad_except(sf: SourceFile) -> List[Finding]:
+    out = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append(sf.finding(
+                "broad-except", node,
+                "bare `except:` also swallows SystemExit and "
+                "KeyboardInterrupt — catch `Exception` at most, and "
+                "log what was caught"))
+            continue
+        names = [node.type] if not isinstance(node.type, ast.Tuple) \
+            else list(node.type.elts)
+        broad = any(dotted(n) in ("Exception", "BaseException")
+                    for n in names)
+        if broad and not _handler_reports(node):
+            out.append(sf.finding(
+                "broad-except", node,
+                "`except Exception` that neither re-raises, logs, nor "
+                "counts — failures vanish silently; log-and-count (obs "
+                "counter) or narrow the type"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JAX tracing rules
+
+
+def _donated_indices(call: ast.Call) -> Set[int]:
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        consts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        return {c.value for c in consts
+                if isinstance(c, ast.Constant) and isinstance(c.value, int)}
+    return set()
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    cn = call_name(call)
+    if cn == "jit" or cn.endswith(".jit"):
+        return True
+    if (cn == "partial" or cn.endswith(".partial")) and call.args:
+        a0 = call.args[0]
+        an = dotted(a0)
+        return an == "jit" or an.endswith(".jit")
+    return False
+
+
+@rule("jax-donate",
+      "a buffer donated via donate_argnums must not be read after "
+      "the call")
+def check_jax_donate(sf: SourceFile) -> List[Finding]:
+    # jitted-with-donation wrappers bound to a name in this file
+    wrappers: Dict[str, Set[int]] = {}
+    for call in _walk_calls(sf.tree):
+        if not _is_jit_call(call):
+            continue
+        idx = _donated_indices(call)
+        if not idx:
+            continue
+        key = _assign_key(call)
+        if key and not key.startswith("."):
+            wrappers[key] = idx
+    out = []
+    for call in _walk_calls(sf.tree):
+        name = call_name(call)
+        donated = wrappers.get(name)
+        if not donated:
+            continue
+        stmt = statement_of(call)
+        scope = enclosing_function(call) or sf.tree
+        # `x = f(x)` rebinds the donated name — the canonical safe idiom
+        rebound: Set[str] = set()
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        rebound.add(n.id)
+        for i in sorted(donated):
+            if i >= len(call.args):
+                continue
+            arg = call.args[i]
+            if not isinstance(arg, ast.Name) or arg.id in rebound:
+                continue
+            for n in ast.walk(scope):
+                if isinstance(n, ast.Name) and n.id == arg.id \
+                        and isinstance(n.ctx, ast.Load) \
+                        and n.lineno > stmt.end_lineno:
+                    out.append(sf.finding(
+                        "jax-donate", n,
+                        f"`{arg.id}` was donated to `{name}` "
+                        f"(donate_argnums={i}) on line {call.lineno} — "
+                        f"its buffer is deleted after the call; reading "
+                        f"it here is undefined"))
+                    break
+    return out
+
+
+def _jitted_functions(sf: SourceFile) -> List[ast.FunctionDef]:
+    """FunctionDefs that are jit targets: decorated with jit /
+    partial(jit, ...) or passed by name to a jit(...) call."""
+    jit_arg_names: Set[str] = set()
+    for call in _walk_calls(sf.tree):
+        if _is_jit_call(call) and call.args:
+            a0 = call.args[0] if call_name(call).endswith("jit") \
+                or call_name(call) == "jit" else \
+                (call.args[1] if len(call.args) > 1 else None)
+            if isinstance(a0, ast.Name):
+                jit_arg_names.add(a0.id)
+    out = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        jitted = node.name in jit_arg_names
+        for dec in node.decorator_list:
+            dn = dotted(dec)
+            if dn == "jit" or dn.endswith(".jit"):
+                jitted = True
+            if isinstance(dec, ast.Call) and _is_jit_call(dec):
+                jitted = True
+        if jitted:
+            out.append(node)
+    return out
+
+
+@rule("jax-jit-capture",
+      "jitted functions must not close over self/cls state")
+def check_jax_jit_capture(sf: SourceFile) -> List[Finding]:
+    out = []
+    for fn in _jitted_functions(sf):
+        params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)}
+        if "self" in params or "cls" in params:
+            out.append(sf.finding(
+                "jax-jit-capture", fn,
+                f"`{fn.name}` is jitted with self/cls as a traced "
+                f"argument — jit retraces per instance and pins the "
+                f"object in the compile cache; jit a free function of "
+                f"explicit arrays instead"))
+            continue
+        for n in ast.walk(ast.Module(body=fn.body, type_ignores=[])):
+            if isinstance(n, ast.Name) and n.id in ("self", "cls") \
+                    and isinstance(n.ctx, ast.Load):
+                out.append(sf.finding(
+                    "jax-jit-capture", n,
+                    f"jitted `{fn.name}` closes over `{n.id}` — the "
+                    f"capture is baked in at trace time, so later "
+                    f"mutations are silently ignored; pass the value "
+                    f"as an argument"))
+                break
+    return out
+
+
+# numpy attributes that are trace-safe metadata, not host array ops
+_NP_OK = {"dtype", "iinfo", "finfo", "result_type", "promote_types",
+          "can_cast", "isscalar", "ndim", "shape",
+          "float16", "float32", "float64", "int8", "int16", "int32",
+          "int64", "uint8", "uint16", "uint32", "uint64", "bool_",
+          "bfloat16"}
+_HOST_MODULES = {"time", "random", "os"}
+
+
+@rule("jax-host-call",
+      "no host numpy / side-effect calls inside traced code")
+def check_jax_host_call(sf: SourceFile) -> List[Finding]:
+    np_aliases = import_aliases(sf.tree, "numpy")
+    out = []
+    for fn in _jitted_functions(sf):
+        for call in _walk_calls(ast.Module(body=fn.body, type_ignores=[])):
+            cn = call_name(call)
+            head, _, tail = cn.partition(".")
+            msg = None
+            if head in np_aliases and tail and tail not in _NP_OK:
+                msg = (f"host numpy call `{cn}(...)` inside jitted "
+                       f"`{fn.name}` runs at trace time on abstract "
+                       f"values (or forces a device sync) — use "
+                       f"jax.numpy")
+            elif head in _HOST_MODULES and tail:
+                msg = (f"side-effecting host call `{cn}(...)` inside "
+                       f"jitted `{fn.name}` only runs at trace time — "
+                       f"hoist it out of the traced function")
+            elif cn == "print":
+                msg = (f"print() inside jitted `{fn.name}` fires at "
+                       f"trace time only — use jax.debug.print")
+            if msg:
+                out.append(sf.finding("jax-host-call", call, msg))
+    return out

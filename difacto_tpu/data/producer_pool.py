@@ -668,11 +668,11 @@ class ProcessProducerPool:
                 q_.put_nowait(("stop",))
             except (ValueError, OSError):  # pragma: no cover
                 pass
-        deadline = time.time() + self._join_timeout
+        deadline = time.monotonic() + self._join_timeout
         for p in self._procs:
             if p.pid is None:
                 continue
-            p.join(timeout=max(0.1, deadline - time.time()))
+            p.join(timeout=max(0.1, deadline - time.monotonic()))
             if p.is_alive():  # pragma: no cover - hung worker
                 p.terminate()
                 p.join(timeout=1.0)

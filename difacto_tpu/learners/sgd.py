@@ -565,7 +565,7 @@ class SGDLearner(Learner):
     def run(self) -> None:
         """RunScheduler (sgd_learner.cc:52-122)."""
         p = self.param
-        self._start_time = time.time()
+        self._start_time = time.monotonic()
         if p.metrics_path and self._flusher is None:
             # periodic JSONL export of this run's registry + the
             # process-global one (faults, DCN counters); final flush +
@@ -872,7 +872,7 @@ class SGDLearner(Learner):
         _, nnz = self.store.evaluate()
         delta.nnz_w = nnz - self._last_nnz
         self._last_nnz = nnz
-        elapsed = time.time() - self._start_time
+        elapsed = time.monotonic() - self._start_time
         self._report.prog.merge(delta)
         print(f"{elapsed:5.0f}  {self._report.print_str()}", flush=True)
 

@@ -76,7 +76,9 @@ def start(path: Optional[str] = None,
     if path:
         _path = path
     _trace_id = (trace_id_ if trace_id_ is not None
-                 else _trace_id or (os.getpid() << 16) | int(time.time()) % (1 << 16))
+                 else _trace_id or (os.getpid() << 16)
+                 # lint: ok(wall-clock) id entropy, not a duration
+                 | int(time.time()) % (1 << 16))
 
 
 def stop() -> None:

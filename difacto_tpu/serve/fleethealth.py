@@ -78,7 +78,11 @@ class FleetHealth:
 
     # ---------------------------------------------------------- writing
     def _append(self, op: str, host: str, port: int) -> None:
-        rec = json.dumps({"ts": round(time.time(), 3), "op": op,
+        # wall clock by design: marks are compared across PROCESSES and
+        # hosts through a shared file; monotonic clocks do not compare
+        rec = json.dumps(
+            {"ts": round(time.time(), 3),  # lint: ok(wall-clock)
+             "op": op,
                           "ep": _key(host, port), "pid": os.getpid()},
                          separators=(",", ":")) + "\n"
         # open-then-lock can race a peer's compaction: if the path was
@@ -134,7 +138,7 @@ class FleetHealth:
         lock. Only currently-down marks survive; clears and expired downs
         are the compactible majority."""
         downs = self._fold(self._read_lines())
-        now = time.time()
+        now = time.time()  # lint: ok(wall-clock) cross-process file ts
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             for ep, (op, ts) in downs.items():
@@ -186,7 +190,7 @@ class FleetHealth:
         """{'host:port': seconds_remaining} for every endpoint currently
         suppressed — a `down` mark younger than ``down_s`` with no later
         `clear`."""
-        now = time.time()
+        now = time.time()  # lint: ok(wall-clock) cross-process file ts
         out: Dict[str, float] = {}
         for ep, (op, ts) in self._state().items():
             remaining = self.down_s - (now - ts)

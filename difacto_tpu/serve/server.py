@@ -297,7 +297,8 @@ class ServeServer:
                     continue
                 try:
                     blk = self._parser(line)
-                except Exception:
+                except Exception as e:
+                    log.debug("bad row %r: %s", line[:80], e)
                     blk = None
                 if blk is None or blk.size != 1:
                     self.stats.record_error()

@@ -83,7 +83,7 @@ class WorkloadPool:
             part = (self._rng.choice(avail) if self.param.wl_shuffle
                     else avail[0])
             self._avail[part] = False
-            self._assigned.append(_Assigned(node, part, _time.time()))
+            self._assigned.append(_Assigned(node, part, _time.monotonic()))
             return part
 
     def finish(self, node: int) -> None:
@@ -102,7 +102,7 @@ class WorkloadPool:
                     rest.append(a)
                     continue
                 if done:
-                    self._times.append(_time.time() - a.start)
+                    self._times.append(_time.monotonic() - a.start)
                     self._avail.pop(a.part, None)
                     self._num_finished += 1
                 else:
@@ -116,7 +116,7 @@ class WorkloadPool:
         queue), so ``remove_stragglers`` measures *stall* time — a healthy
         part waiting for the consumer is not a straggler."""
         with self._mu:
-            now = _time.time()
+            now = _time.monotonic()
             self._assigned = [a._replace(start=now) if a.node == node else a
                               for a in self._assigned]
 
@@ -141,7 +141,7 @@ class WorkloadPool:
                 return []
             mean = sum(self._times) / len(self._times)
             limit = max(mean * 10, self.param.straggler_timeout)
-            now = _time.time() if now is None else now
+            now = _time.monotonic() if now is None else now
             rest, requeued = [], []
             for a in self._assigned:
                 if now - a.start > limit:

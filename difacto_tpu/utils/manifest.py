@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import re
 import zipfile
 from typing import Dict, List, Optional, Tuple
@@ -38,6 +39,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from . import stream
+
+log = logging.getLogger("difacto_tpu")
 
 MANIFEST_SUFFIX = ".manifest.json"
 FORMAT = 1
@@ -260,8 +263,8 @@ class VerifiedNpz:
     def close(self) -> None:
         try:
             self._npz.close()
-        except Exception:  # pragma: no cover - np.load handles vary
-            pass
+        except Exception as e:  # pragma: no cover - np.load handles vary
+            log.debug("npz close failed for %s: %s", self.uri, e)
 
     def __enter__(self) -> "VerifiedNpz":
         return self

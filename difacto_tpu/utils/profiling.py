@@ -13,9 +13,12 @@ harness. Here:
 from __future__ import annotations
 
 import contextlib
+import logging
 import time
 from collections import defaultdict
 from typing import Dict, Iterator
+
+log = logging.getLogger("difacto_tpu")
 
 
 class Timer:
@@ -50,13 +53,13 @@ def device_trace(log_dir: str) -> Iterator[None]:
     try:
         jax.profiler.start_trace(log_dir)
         started = True
-    except Exception:  # pragma: no cover - backend-dependent
-        pass
+    except Exception as e:  # pragma: no cover - backend-dependent
+        log.debug("device trace unavailable: %s", e)
     try:
         yield
     finally:
         if started:
             try:
                 jax.profiler.stop_trace()
-            except Exception:  # pragma: no cover
-                pass
+            except Exception as e:  # pragma: no cover
+                log.debug("stop_trace failed: %s", e)

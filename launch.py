@@ -277,7 +277,7 @@ def _run_shim_ranked(args, cmd, rdv: str, rank: int, hostname: str) -> int:
     # its peers fast instead of leaving them blocked in a collective —
     # so every task waits for ALL host files (they appear during the
     # same startup window as host-0)
-    deadline = time.time() + args.rendezvous_timeout
+    deadline = time.monotonic() + args.rendezvous_timeout
     hosts = [None] * args.num_processes
     while any(h is None for h in hosts):
         for r in range(args.num_processes):
@@ -287,7 +287,7 @@ def _run_shim_ranked(args, cmd, rdv: str, rank: int, hostname: str) -> int:
                     with open(p) as f:
                         hosts[r] = f.read().strip()
         if any(h is None for h in hosts):
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 missing = [r for r, h in enumerate(hosts) if h is None]
                 raise SystemExit(
                     f"rendezvous timeout: no host file for rank(s) "
@@ -327,7 +327,7 @@ def _wait_cluster_rcs(rdv: str, n: int, timeout: float) -> int:
     (--job-timeout; 0 = wait forever) — it is deliberately separate from
     --rendezvous-timeout, which bounds only task startup: a training run
     outlives any sane rendezvous deadline."""
-    deadline = time.time() + timeout if timeout > 0 else None
+    deadline = time.monotonic() + timeout if timeout > 0 else None
     seen = {}
     while len(seen) < n:
         for rank in range(n):
@@ -337,7 +337,7 @@ def _wait_cluster_rcs(rdv: str, n: int, timeout: float) -> int:
             if os.path.exists(p):
                 with open(p) as f:
                     seen[rank] = int(f.read().strip() or "1")
-        if len(seen) < n and deadline is not None and time.time() > deadline:
+        if len(seen) < n and deadline is not None and time.monotonic() > deadline:
             missing = [r for r in range(n) if r not in seen]
             print(f"[launch] timeout waiting for rank(s) {missing} in {rdv}",
                   file=sys.stderr)
@@ -376,7 +376,8 @@ def run_cluster(args, cmd) -> int:
     # run's result). The submit time + pid make the path unique; the
     # shims receive it fully resolved on their command line.
     args.rendezvous_dir = os.path.join(
-        args.rendezvous_dir, f"run-{int(time.time())}-{os.getpid()}")
+        args.rendezvous_dir,
+        f"run-{int(time.time())}-{os.getpid()}")  # lint: ok(wall-clock) stamp
     rdv = args.rendezvous_dir
     os.makedirs(rdv, exist_ok=False)
     if args.launcher == "mpi":

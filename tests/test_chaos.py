@@ -451,6 +451,87 @@ def test_client_retries_through_socket_close(ckpt_model, rcv1_path):
             srv.close()
 
 
+def test_client_retries_through_socket_read_close(ckpt_model, rcv1_path):
+    """The READ half of the wire drill (the write half is above): the
+    server's reader drops the connection mid-request stream (injected
+    serve.sock.read close); the retrying client reconnects and resends
+    the unanswered tail until every row is scored."""
+    from difacto_tpu.serve import (ServeClient, ServeServer,
+                                   open_serving_store)
+    rows = fixture_rows(rcv1_path)
+    with deadline(180):
+        store, _, _ = open_serving_store(ckpt_model)
+        srv = ServeServer(store, batch_size=10,
+                          max_delay_ms=5.0).start()
+        # every 31st request read tears the connection down; 10-row
+        # calls keep each retry attempt under the next fire (a 100-row
+        # burst could be torn before any response flushes — no progress)
+        faultinject.configure("serve.sock.read:close@1:30")
+        got = []
+        try:
+            with ServeClient(srv.host, srv.port, retries=10,
+                             deadline_s=120.0) as c:
+                for i in range(0, len(rows), 10):
+                    got.extend(c.predict(rows[i:i + 10]))
+            fired = faultinject.stats()
+        finally:
+            faultinject.configure("")
+            srv.close()
+        assert fired.get("serve.sock.read", 0) >= 2, \
+            f"injected read close never fired: {fired}"
+        assert len(got) == 100
+        assert all(g is not None and 0.0 < g < 1.0 for g in got)
+
+
+def test_batcher_enqueue_fault_surfaces_as_err(ckpt_model, rcv1_path):
+    """An injected admission failure (batcher.enqueue err) must surface
+    as a per-row `!err` reply — counted, never retried, never a torn
+    connection — and service must resume the moment the fault disarms."""
+    from difacto_tpu.serve import (ServeClient, ServeServer,
+                                   open_serving_store)
+    rows = fixture_rows(rcv1_path)[:10]
+    with deadline(120):
+        store, _, _ = open_serving_store(ckpt_model)
+        srv = ServeServer(store, batch_size=8, max_delay_ms=5.0).start()
+        faultinject.configure("batcher.enqueue:err@1")
+        try:
+            with ServeClient(srv.host, srv.port, retries=2) as c:
+                got = c.predict(rows)
+                fired = faultinject.stats()
+                assert got == [None] * len(rows)
+                assert c.stats()["errors"] >= len(rows)
+                faultinject.configure("")
+                # same server, same connection: admission works again
+                assert all(g is not None and 0.0 < g < 1.0
+                           for g in c.predict(rows))
+        finally:
+            faultinject.configure("")
+            srv.close()
+        assert fired.get("batcher.enqueue", 0) >= len(rows), \
+            f"injected enqueue fault never fired: {fired}"
+
+
+def test_ckpt_read_fault_is_typed(ckpt_model):
+    """An injected read failure on checkpoint open (ckpt.read err) keeps
+    its OSError type through the verified-load path — it must look like
+    the real disk failure it models, never a silent partial load (the
+    corrupt-file walk-back catches CheckpointCorrupt only)."""
+    from difacto_tpu.serve import open_serving_store
+    from difacto_tpu.utils.faultinject import FaultInjected
+    with deadline(60):
+        faultinject.configure("ckpt.read:err@1")
+        try:
+            with pytest.raises(FaultInjected):
+                open_serving_store(ckpt_model)
+            fired = faultinject.stats()
+        finally:
+            faultinject.configure("")
+        assert fired.get("ckpt.read", 0) >= 1
+        # disarmed: the same family loads clean
+        store, _, _ = open_serving_store(ckpt_model)
+        assert store is not None
+
+
 def test_producer_part_fault_is_retried(rcv1_path, tmp_path):
     """An injected producer failure rides the straggler/re-queue path:
     training still completes and writes a loadable model."""

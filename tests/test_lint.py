@@ -1,0 +1,525 @@
+"""difacto-lint fixture suite (docs/static_analysis.md).
+
+Three layers, all tier-1:
+
+- **per-rule fixtures** — for every local rule one true-positive
+  snippet that must be flagged EXACTLY once, plus negative and
+  suppressed twins that must be clean;
+- **cross-rule fixtures** — tiny synthetic packages exercising each
+  registry-drift rule's drifted and in-sync shapes;
+- **the machinery** — JSON output schema, baseline add/expire,
+  suppression pragma placement, exit codes, parse errors — and the
+  the-tree-is-clean gate: the analyzer over this very repo must report
+  zero unsuppressed, non-baselined findings.
+
+Everything runs the analyzer in-process (no subprocesses): the whole
+suite is a few hundred milliseconds.
+"""
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from difacto_tpu.analysis import core
+from difacto_tpu.analysis.cli import main as lint_main
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def lint_src(tmp_path, src, rules, filename="mod.py"):
+    """Run selected rules over one source snippet; return active
+    findings."""
+    (tmp_path / filename).write_text(textwrap.dedent(src))
+    project = core.Project(tmp_path, [filename])
+    res = core.run_project(project, rules)
+    return res.active
+
+
+# ---------------------------------------------------------------------------
+# local-rule fixtures: (rule, true-positive, negative). The suppressed
+# twin is generated from the true positive by pragma-tagging every line.
+
+LOCAL_FIXTURES = [
+    ("thread-daemon", """
+        import threading
+        def f():
+            t = threading.Thread(target=print)
+            t.start()
+     """, """
+        import threading
+        def f():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+        def g():
+            t = threading.Thread(target=print)
+            t.start()
+            t.join()
+     """),
+    ("lock-release", """
+        import threading
+        lock = threading.Lock()
+        def f():
+            lock.acquire()
+            print("critical")
+            lock.release()  # an exception above leaks the lock
+     """, """
+        import threading
+        lock = threading.Lock()
+        def f():
+            lock.acquire()
+            try:
+                print("critical")
+            finally:
+                lock.release()
+        def g():
+            with lock:
+                print("critical")
+        def h():
+            if not lock.acquire(timeout=1):
+                return
+            try:
+                print("critical")
+            finally:
+                lock.release()
+     """),
+    ("resource-close", """
+        import socket
+        def f():
+            s = socket.socket()
+            s.connect(("h", 1))
+     """, """
+        import socket
+        def ok_with():
+            with socket.socket() as s:
+                s.connect(("h", 1))
+        def ok_finally():
+            s = socket.socket()
+            try:
+                s.connect(("h", 1))
+            finally:
+                s.close()
+        def ok_escapes():
+            s = socket.socket()
+            return s
+        def ok_handed_off(pool):
+            s = socket.socket()
+            pool.add(s)
+     """),
+    ("wall-clock", """
+        import time
+        def f():
+            t0 = time.time()
+            return time.monotonic() - t0
+     """, """
+        import time
+        def f():
+            t0 = time.monotonic()
+            return time.monotonic() - t0
+     """),
+    ("broad-except", """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+     """, """
+        import logging
+        log = logging.getLogger(__name__)
+        def ok_logs():
+            try:
+                g()
+            except Exception as e:
+                log.warning("g failed: %s", e)
+        def ok_reraises():
+            try:
+                g()
+            except Exception:
+                raise RuntimeError("context")
+        def ok_captures():
+            errs = []
+            try:
+                g()
+            except BaseException as e:
+                errs.append(e)
+        def ok_narrow():
+            try:
+                g()
+            except ValueError:
+                pass
+     """),
+    ("jax-donate", """
+        import jax
+        def run(step, x):
+            step2 = jax.jit(step, donate_argnums=(0,))
+            y = step2(x)
+            return x
+     """, """
+        import jax
+        def run(step, x):
+            step2 = jax.jit(step, donate_argnums=(0,))
+            x = step2(x)
+            return x
+     """),
+    ("jax-jit-capture", """
+        import jax
+        class Model:
+            def make(self):
+                @jax.jit
+                def inner(a):
+                    return a * self.scale
+                return inner
+     """, """
+        import jax
+        class Model:
+            def make(self):
+                scale = self.scale
+                @jax.jit
+                def inner(a, s):
+                    return a * s
+                return inner
+     """),
+    ("jax-host-call", """
+        import jax
+        import numpy as np
+        @jax.jit
+        def f(a):
+            return np.sum(a)
+     """, """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        @jax.jit
+        def f(a):
+            return jnp.sum(a.astype(np.float32))
+        def host(a):
+            return np.sum(a)
+     """),
+]
+
+
+@pytest.mark.parametrize("rule,bad,good",
+                         LOCAL_FIXTURES,
+                         ids=[r for r, _, _ in LOCAL_FIXTURES])
+def test_local_rule_true_positive_fires_exactly_once(tmp_path, rule, bad,
+                                                     good):
+    found = lint_src(tmp_path, bad, [rule])
+    assert len(found) == 1, \
+        f"{rule}: expected exactly 1 finding, got {found}"
+    assert found[0].rule == rule
+    assert found[0].line > 0 and found[0].message
+
+
+@pytest.mark.parametrize("rule,bad,good",
+                         LOCAL_FIXTURES,
+                         ids=[r for r, _, _ in LOCAL_FIXTURES])
+def test_local_rule_negative_fixture_is_clean(tmp_path, rule, bad, good):
+    assert lint_src(tmp_path, good, [rule]) == []
+
+
+@pytest.mark.parametrize("rule,bad,good",
+                         LOCAL_FIXTURES,
+                         ids=[r for r, _, _ in LOCAL_FIXTURES])
+def test_local_rule_suppression_pragma_silences(tmp_path, rule, bad, good):
+    tagged = "\n".join(
+        line + f"  # lint: ok({rule})" if line.strip() else line
+        for line in textwrap.dedent(bad).splitlines())
+    (tmp_path / "mod.py").write_text(tagged)
+    res = core.run_project(core.Project(tmp_path, ["mod.py"]), [rule])
+    assert res.active == []
+    assert sum(f.suppressed for f in res.findings) == 1
+
+
+def test_standalone_pragma_covers_next_code_line(tmp_path):
+    src = ("import time\n"
+           "# lint: ok(wall-clock) timestamp-of-record\n"
+           "STAMP = time.time()\n")
+    (tmp_path / "mod.py").write_text(src)
+    res = core.run_project(core.Project(tmp_path, ["mod.py"]),
+                           ["wall-clock"])
+    assert res.active == [] and len(res.findings) == 1
+
+
+def test_jit_method_self_argument_flagged(tmp_path):
+    found = lint_src(tmp_path, """
+        import jax
+        class Model:
+            @jax.jit
+            def step(self, x):
+                return x
+     """, ["jax-jit-capture"])
+    assert len(found) == 1 and "traced" in found[0].message
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    found = lint_src(tmp_path, "def broken(:\n", ["wall-clock"])
+    assert [f.rule for f in found] == ["parse-error"]
+
+
+# ---------------------------------------------------------------------------
+# cross-rule fixtures: tiny synthetic projects
+
+
+_PROJ_SEQ = [0]
+
+
+def make_project(tmp_path, files, **kw):
+    _PROJ_SEQ[0] += 1
+    root = tmp_path / f"proj{_PROJ_SEQ[0]}"
+    for rel, text in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    lint = sorted({rel.split("/")[0] for rel in files if rel.endswith(".py")
+                   and not rel.startswith(("tests/", "docs/"))})
+    return core.Project(root, lint, **kw)
+
+
+def test_fault_registry_drift_and_sync(tmp_path):
+    proj = make_project(tmp_path, {
+        "pkg/mod.py": """
+            from utils import faultinject
+            def work():
+                faultinject.fire("my.point")
+        """,
+    })
+    rules = ["fault-registry"]
+    found = core.run_project(proj, rules).active
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 2
+    assert "never armed" in msgs and "undocumented" in msgs
+
+    proj = make_project(tmp_path, {
+        "pkg/mod.py": """
+            from utils import faultinject
+            def work():
+                faultinject.fire("my.point")
+        """,
+        "tests/test_mod.py": 'FAULTS = "my.point:err@1"\n',
+        "docs/chaos.md": "fault points: `my.point` tears the thing\n",
+    })
+    assert core.run_project(proj, rules).active == []
+
+
+def test_fault_registry_rejects_unknown_kind(tmp_path):
+    proj = make_project(tmp_path, {
+        "pkg/mod.py": """
+            from utils import faultinject
+            faultinject.fire("my.point")
+        """,
+        "tests/test_mod.py": 'FAULTS = "my.point:explode@1"\n',
+        "docs/chaos.md": "`my.point`\n",
+    })
+    found = core.run_project(proj, ["fault-registry"]).active
+    assert len(found) == 1 and "explode" in found[0].message
+
+
+def test_metric_registry_type_conflict_and_missing_doc(tmp_path):
+    proj = make_project(tmp_path, {
+        "pkg/a.py": 'from obs import counter\n'
+                    'c = counter("my_widgets_total", "desc")\n',
+        "pkg/b.py": 'from obs import gauge\n'
+                    'g = gauge("my_widgets_total", "desc")\n',
+        "docs/observability.md": "catalog: `my_widgets_total`\n",
+    })
+    found = core.run_project(proj, ["metric-registry"]).active
+    assert len(found) == 1
+    assert "one name must keep one type" in found[0].message
+
+    proj = make_project(tmp_path, {
+        "pkg/a.py": 'from obs import counter\n'
+                    'c = counter("my_widgets_total", "desc")\n',
+        "docs/observability.md": "catalog has nothing\n",
+    })
+    found = core.run_project(proj, ["metric-registry"]).active
+    assert len(found) == 1 and "missing from" in found[0].message
+
+
+def test_control_registry_two_way_match(tmp_path):
+    files = {
+        "srv/server.py": 'HANDLED = ("#stats", "#orphan")\n',
+        "cli/client.py": 'SENT = ("#stats", "#lost")\n',
+        "docs/wire.md": "`#stats` `#orphan` `#lost`\n",
+    }
+    proj = make_project(
+        tmp_path, files,
+        handler_files=("srv/server.py",), sender_files=("cli/client.py",))
+    found = core.run_project(proj, ["control-registry"]).active
+    by_msg = {f.message.split('"')[1]: f.message for f in found}
+    assert set(by_msg) == {"#orphan", "#lost"}
+    assert "ever sends" in by_msg["#orphan"]
+    assert "never handles" in by_msg["#lost"]
+
+    files["srv/server.py"] = 'HANDLED = ("#stats",)\n'
+    files["cli/client.py"] = 'SENT = ("#stats",)\n'
+    proj = make_project(
+        tmp_path, files,
+        handler_files=("srv/server.py",), sender_files=("cli/client.py",))
+    assert core.run_project(proj, ["control-registry"]).active == []
+
+
+def test_control_registry_requires_docs_entry(tmp_path):
+    proj = make_project(
+        tmp_path,
+        {"srv/server.py": 'H = "#stats"\n',
+         "cli/client.py": 'S = "#stats"\n',
+         "docs/wire.md": "nothing here\n"},
+        handler_files=("srv/server.py",), sender_files=("cli/client.py",))
+    found = core.run_project(proj, ["control-registry"]).active
+    assert len(found) == 1 and "undocumented" in found[0].message
+
+
+def test_config_registry_undeclared_knob_and_env(tmp_path):
+    proj = make_project(tmp_path, {
+        "pkg/mod.py": """
+            import os
+            from config import Param
+            class FooParam(Param):
+                declared_knob: int = 1
+            def read(kwargs):
+                a = next(v for k, v in kwargs if k == "declared_knob")
+                b = next(v for k, v in kwargs if k == "mystery_knob")
+                return a, b, os.environ.get("DIFACTO_SECRET")
+        """,
+        "docs/conf.md": "knobs: declared_knob\n",
+    })
+    found = core.run_project(proj, ["config-registry"]).active
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 2
+    assert "mystery_knob" in msgs and "DIFACTO_SECRET" in msgs
+
+    proj = make_project(tmp_path, {
+        "pkg/mod.py": """
+            import os
+            from config import Param
+            class FooParam(Param):
+                declared_knob: int = 1
+            def read(kwargs):
+                a = next(v for k, v in kwargs if k == "declared_knob")
+                return a, os.environ.get("DIFACTO_SECRET")
+        """,
+        "docs/conf.md": "knobs: declared_knob, DIFACTO_SECRET\n",
+    })
+    assert core.run_project(proj, ["config-registry"]).active == []
+
+
+# ---------------------------------------------------------------------------
+# machinery: output formats, baseline, exit codes
+
+
+def _bad_tree(tmp_path):
+    (tmp_path / "mod.py").write_text(
+        "import time\n\ndef f():\n    return time.time()\n")
+    return tmp_path
+
+
+def test_json_output_schema(tmp_path, capsys):
+    _bad_tree(tmp_path)
+    rc = lint_main(["--root", str(tmp_path), "mod.py", "--format", "json",
+                    "--rules", "wall-clock"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == core.JSON_VERSION
+    assert set(doc["counts"]) == {"files", "total", "active", "suppressed",
+                                  "baselined", "expired_baseline"}
+    assert doc["counts"] == {"files": 1, "total": 1, "active": 1,
+                             "suppressed": 0, "baselined": 0,
+                             "expired_baseline": 0}
+    (finding,) = doc["findings"]
+    assert set(finding) >= {"rule", "path", "line", "message",
+                            "fingerprint", "suppressed", "baselined"}
+    assert finding["rule"] == "wall-clock" and finding["path"] == "mod.py"
+    assert isinstance(doc["expired_baseline"], list)
+
+
+def test_github_format_annotations(tmp_path, capsys):
+    _bad_tree(tmp_path)
+    rc = lint_main(["--root", str(tmp_path), "mod.py", "--format", "github",
+                    "--rules", "wall-clock"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert out.startswith("::error file=mod.py,line=4,")
+    assert "wall-clock" in out
+
+
+def test_baseline_add_then_expire(tmp_path, capsys):
+    _bad_tree(tmp_path)
+    baseline = tmp_path / ".lint-baseline.json"
+    args = ["--root", str(tmp_path), "mod.py", "--rules", "wall-clock"]
+
+    # findings fail the run until intentionally baselined
+    assert lint_main(args) == 1
+    assert lint_main(args + ["--write-baseline"]) == 0
+    data = json.loads(baseline.read_text())
+    assert data["version"] == core.BASELINE_VERSION
+    assert len(data["findings"]) == 1
+    capsys.readouterr()
+
+    # grandfathered: same finding no longer fails, reported as baselined
+    rc = lint_main(args + ["--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["counts"]["baselined"] == 1 and doc["counts"]["active"] == 0
+
+    # a NEW finding is not masked by the old baseline entry
+    (tmp_path / "mod.py").write_text(
+        "import time\n\ndef f():\n    return time.time()\n"
+        "\ndef g():\n    return time.time()\n")
+    assert lint_main(args) == 1
+    capsys.readouterr()
+
+    # the flagged line was fixed: entry expires, run stays green and
+    # says so (regenerate with make lint-baseline)
+    (tmp_path / "mod.py").write_text(
+        "import time\n\ndef f():\n    return time.monotonic()\n")
+    rc = lint_main(args + ["--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["counts"]["active"] == 0
+    assert len(doc["expired_baseline"]) == 1
+    assert lint_main(args + ["--write-baseline"]) == 0
+    assert json.loads(baseline.read_text())["findings"] == {}
+
+
+def test_fingerprints_survive_line_drift(tmp_path):
+    (tmp_path / "mod.py").write_text("import time\nt = time.time()\n")
+    res = core.run_project(core.Project(tmp_path, ["mod.py"]),
+                           ["wall-clock"])
+    fp0 = res.findings[0].fingerprint()
+    (tmp_path / "mod.py").write_text(
+        "import time\n\n# a new comment above\n\nt = time.time()\n")
+    res = core.run_project(core.Project(tmp_path, ["mod.py"]),
+                           ["wall-clock"])
+    assert res.findings[0].fingerprint() == fp0
+
+
+def test_unknown_rule_is_usage_error(tmp_path, capsys):
+    _bad_tree(tmp_path)
+    assert lint_main(["--root", str(tmp_path), "mod.py",
+                      "--rules", "no-such-rule"]) == 2
+
+
+def test_list_rules_names_every_registered_rule(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in core.all_rules():
+        assert rid in out
+
+
+# ---------------------------------------------------------------------------
+# the gate: this tree is clean
+
+
+def test_the_tree_is_clean(capsys):
+    """`make lint` on the repo: zero unsuppressed, non-baselined
+    findings. If this fails, run `python tools/lint.py` and either fix
+    the finding, annotate it with a reasoned `# lint: ok(rule)`, or —
+    for intentional grandfathering only — `make lint-baseline`."""
+    rc = lint_main(["--root", str(REPO_ROOT), "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0, f"tree has lint findings: {doc['findings']}"
+    assert doc["counts"]["active"] == 0
+    # the suite itself keeps the analyzer honest: suppressions in the
+    # tree must stay rare and reasoned (bump deliberately when adding)
+    assert doc["counts"]["suppressed"] <= 12

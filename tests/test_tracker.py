@@ -46,7 +46,7 @@ def test_pool_straggler_reissue():
         pool.finish(1)
     slow = pool.get(node=2)
     # pretend the slow part has been running far past the threshold
-    requeued = pool.remove_stragglers(now=time.time() + 3600)
+    requeued = pool.remove_stragglers(now=time.monotonic() + 3600)
     assert requeued == [slow]
     assert pool.get(node=3) == slow  # re-issued to another node
 
@@ -55,7 +55,7 @@ def test_pool_straggler_needs_history():
     pool = WorkloadPool(WorkloadPoolParam(straggler_timeout=0.01))
     pool.add(2)
     pool.get(node=1)
-    assert pool.remove_stragglers(now=time.time() + 3600) == []
+    assert pool.remove_stragglers(now=time.monotonic() + 3600) == []
 
 
 def test_reporter_throttle():

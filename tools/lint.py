@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""difacto-lint entry point — `make lint` runs this.
+
+Thin wrapper so the analyzer works from a checkout without installing
+the package: ``python tools/lint.py [paths...] [--format=...]``.
+See docs/static_analysis.md for the rule catalog and the suppression /
+baseline workflow.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from difacto_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
