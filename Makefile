@@ -15,8 +15,8 @@ DATA_IN ?= data.txt
 DATA_FORMAT ?= criteo
 DATA_OUT ?= $(basename $(DATA_IN)).rec
 
-.PHONY: test smoke ci lint lint-baseline chaos fleet-chaos obs-report \
-	convert stream-bench
+.PHONY: test smoke ci lint lint-changed lint-baseline lockmap chaos \
+	fleet-chaos obs-report convert stream-bench
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -31,11 +31,26 @@ lint:
 	$(PY) -m compileall -q difacto_tpu tests tools bench.py launch.py
 	$(PY) tools/lint.py --format=$(LINT_FORMAT)
 
+# fast local loop: local rules only on files changed vs the merge-base
+# (worktree edits + untracked included); cross-file and concurrency
+# rules still see the whole tree — their findings can live in files the
+# change never touched
+lint-changed:
+	$(PY) tools/lint.py --changed-only --format=$(LINT_FORMAT)
+
 # regenerate the grandfathered-finding baseline INTENTIONALLY (e.g.
 # after adding a rule that flags pre-existing code you are not fixing
 # in the same change) — never to silence a finding you just introduced
 lint-baseline:
 	$(PY) tools/lint.py --write-baseline
+
+# merged static+dynamic lock-order graph (docs/static_analysis.md):
+#   make lockmap                          # static model only
+#   make lockmap LOCKTRACE=run.locks.json # + a DIFACTO_LOCKTRACE_OUT dump
+LOCKTRACE ?=
+lockmap:
+	$(PY) tools/lockmap.py --dot lockmap.dot --json lockmap.json \
+	  $(if $(LOCKTRACE),--dynamic $(LOCKTRACE))
 
 # resilience suite alone (fault injection, drain, blue/green, takeover,
 # client failover — tests/test_chaos.py and friends)

@@ -11,16 +11,25 @@ conventions as checkable rules — stdlib ``ast`` only, no new deps.
 
 Layout:
 
-- :mod:`core`       — rule framework: findings, ``# lint: ok(rule-id)``
+- :mod:`core`        — rule framework: findings, ``# lint: ok(rule-id)``
   inline suppressions, the checked-in baseline, output formats, exit
   codes, the project index cross-file rules read.
-- :mod:`localrules` — single-file rules (thread lifecycle, lock
+- :mod:`localrules`  — single-file rules (thread lifecycle, lock
   release, resource close, the monotonic-clock contract, broad
   excepts, the three JAX tracing rules).
-- :mod:`crossrules` — project-wide registry-drift rules (fault points,
+- :mod:`crossrules`  — project-wide registry-drift rules (fault points,
   metric names, ``#control`` lines, config knobs).
-- :mod:`cli`        — ``python -m difacto_tpu.analysis`` /
-  ``tools/lint.py`` / ``make lint``.
+- :mod:`callgraph`   — the project-wide call graph (imports, methods,
+  thread hand-off edges) the interprocedural layer is built on.
+- :mod:`concurrency` — held-lock-set propagation over the call graph:
+  lock-order cycle detection (``lock-order``), blocking-calls-under-
+  lock (``lock-blocking``), Condition-wait discipline
+  (``cond-wait-while``); the static half of the lock sentinel
+  (utils/locktrace.py is the runtime half, tools/lockmap.py the
+  merged view).
+- :mod:`cli`         — ``python -m difacto_tpu.analysis`` /
+  ``tools/lint.py`` / ``make lint`` (``--changed-only`` for the
+  incremental loop).
 """
 
 from .core import Finding, Project, all_rules, run_project  # noqa: F401

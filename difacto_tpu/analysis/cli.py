@@ -43,10 +43,54 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated rule ids to run (default: all)")
     p.add_argument("--no-cross", action="store_true",
                    help="skip cross-file registry rules (partial runs)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="incremental mode: run LOCAL rules only on files "
+                        "changed vs the merge-base (cross-file and "
+                        "concurrency rules still see the whole tree); "
+                        "make lint-changed")
+    p.add_argument("--base", default=None,
+                   help="merge-base ref for --changed-only (default: "
+                        "origin/main, then main)")
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true",
                    help="text format: also print suppressed/baselined")
     return p
+
+
+def changed_files(root, base=None):
+    """Repo-relative ``.py`` paths changed vs the merge-base with
+    ``base`` (default: origin/main, then main), plus worktree/index
+    edits and untracked files — the --changed-only lint set. Returns
+    None when git is unusable (callers fall back to a full run)."""
+    import subprocess
+
+    def git(*args):
+        return subprocess.run(["git", "-C", str(root), *args],
+                              capture_output=True, text=True, timeout=30)
+
+    try:
+        if git("rev-parse", "--git-dir").returncode != 0:
+            return None
+        names = set()
+        merge_base = None
+        for ref in ([base] if base else ["origin/main", "main"]):
+            r = git("merge-base", "HEAD", ref)
+            if r.returncode == 0:
+                merge_base = r.stdout.strip()
+                break
+        if merge_base:
+            r = git("diff", "--name-only", merge_base, "HEAD")
+            if r.returncode == 0:
+                names |= set(r.stdout.split())
+        r = git("diff", "--name-only", "HEAD")   # worktree + index
+        if r.returncode == 0:
+            names |= set(r.stdout.split())
+        r = git("ls-files", "--others", "--exclude-standard")
+        if r.returncode == 0:
+            names |= set(r.stdout.split())
+        return {n for n in names if n.endswith(".py")}
+    except (OSError, subprocess.SubprocessError):
+        return None
 
 
 def main(argv=None) -> int:
@@ -69,9 +113,16 @@ def main(argv=None) -> int:
 
     root = Path(args.root).resolve()
     paths = args.paths or [p for p in DEFAULT_PATHS if (root / p).exists()]
+    local_files = None
+    if args.changed_only:
+        local_files = changed_files(root, args.base)
+        if local_files is None:
+            print("difacto-lint: --changed-only needs git; running the "
+                  "full tree", file=sys.stderr)
     try:
         project = core.Project(root, paths)
-        res = core.run_project(project, rule_ids)
+        res = core.run_project(project, rule_ids,
+                               local_files=local_files)
     except ValueError as e:
         print(f"difacto-lint: {e}", file=sys.stderr)
         return 2

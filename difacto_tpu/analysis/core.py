@@ -89,7 +89,7 @@ def rule(rule_id: str, summary: str, cross: bool = False):
 
 def all_rules() -> Dict[str, Rule]:
     # import for side effect: the @rule decorators populate RULES
-    from . import crossrules, localrules  # noqa: F401
+    from . import concurrency, crossrules, localrules  # noqa: F401
     return RULES
 
 
@@ -369,7 +369,13 @@ class RunResult:
 
 
 def run_project(project: Project,
-                rule_ids: Optional[Iterable[str]] = None) -> RunResult:
+                rule_ids: Optional[Iterable[str]] = None,
+                local_files: Optional[set] = None) -> RunResult:
+    """Run rules over the project. ``local_files`` (a set of repo-
+    relative paths) restricts LOCAL rules to those files — the
+    ``--changed-only`` incremental mode; cross-file and concurrency
+    rules always see the whole tree (their findings can live in files
+    the change never touched)."""
     rules = all_rules()
     if rule_ids is not None:
         unknown = set(rule_ids) - set(rules)
@@ -379,6 +385,8 @@ def run_project(project: Project,
     res = RunResult(files=len(project.files))
     by_file = {sf.rel: sf for sf in project.files}
     for sf in project.files:
+        if local_files is not None and sf.rel not in local_files:
+            continue
         if sf.parse_error is not None:
             res.findings.append(Finding(
                 "parse-error", sf.rel, 0,

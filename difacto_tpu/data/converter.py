@@ -28,6 +28,7 @@ import numpy as np
 
 from ..config import KWArgs, Param
 from ..utils import stream
+from ..utils.locktrace import mutex
 from .localizer import compact
 from .reader import Reader
 from .rec import write_rec_block
@@ -182,12 +183,11 @@ DEFAULT_MEMBER_ROWS = 8192
 
 class Converter:
     def __init__(self) -> None:
-        import threading
         self.param: ConverterParam | None = None
         # filled by run(): rows, eps, parse_s, write_s, procs, members —
         # the per-stage convert accounting bench.py reports (convert.*)
         self.stats: dict = {}
-        self._stage_lock = threading.Lock()
+        self._stage_lock = mutex()
 
     def member_rows(self) -> int:
         """Resolved rows-per-member (see ConverterParam.rec_batch_size):
@@ -357,14 +357,13 @@ class Converter:
         split = p.part_size > 0
         limit = p.part_size * (1 << 20) if split else None
 
-        import threading
         nrows = 0
         ipart = 0
         nblk = 0
         written = [0]  # compressed bytes in current part (approximate:
         # updated as write futures land; part rollover is checked between
         # member submissions)
-        written_lock = threading.Lock()  # += from concurrent workers
+        written_lock = mutex()  # += from concurrent workers
         out_dir = self._open_rec_part(ipart, split)
 
         def write_member(path: str, blk: RowBlock) -> int:

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
+from ..utils.locktrace import mutex
 
 
 class ShapeSchedule:
@@ -42,7 +43,7 @@ class ShapeSchedule:
 
     def __init__(self) -> None:
         self._caps: dict = {}
-        self._lock = threading.Lock()
+        self._lock = mutex()
 
     def cap(self, key: str, n: int, minimum: int = 8,
             exact: bool = False) -> int:

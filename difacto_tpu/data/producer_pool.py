@@ -49,6 +49,7 @@ import time
 from typing import Callable, Iterator, Optional
 
 from ..tracker.workload_pool import WorkloadPool, WorkloadPoolParam
+from ..utils.locktrace import mutex
 
 _END = object()
 
@@ -77,7 +78,7 @@ class OrderedProducerPool:
         self._fail_counts = [0] * n_parts
         self._enqueued = [0] * n_parts  # items already delivered per part
         self._gen = [0] * n_parts       # per-part attempt generation
-        self._locks = [threading.Lock() for _ in range(n_parts)]
+        self._locks = [mutex() for _ in range(n_parts)]
         self._threads = [
             threading.Thread(target=self._work, args=(w,), daemon=True)
             for w in range(self.n_workers)

@@ -15,6 +15,7 @@ import os
 import subprocess
 import threading
 from typing import Optional
+from ..utils.locktrace import mutex
 
 log = logging.getLogger("difacto_tpu")
 
@@ -24,7 +25,7 @@ _SRC = [os.path.join(_DIR, "libsvm_parser.cc"),
         os.path.join(_DIR, "criteo_parser.cc"),
         os.path.join(_DIR, "adfea_parser.cc")]
 
-_lock = threading.Lock()
+_lock = mutex()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
@@ -69,6 +70,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
         _tried = True
         stale = (not os.path.exists(_SO)
                  or os.path.getmtime(_SO) < _newest_src_mtime())
+        # the first-use build is serialized on purpose: every caller
+        # needs its result anyway, and the compile is bounded by the
+        # subprocess timeout=120 (concurrent PROCESS builders are
+        # already safe via the per-pid tmp + atomic replace)
+        # lint: ok(lock-blocking) intentional bounded build under the init lock
         if stale and not _build():
             return None
         try:

@@ -40,6 +40,7 @@ import os
 import threading
 from bisect import bisect_left
 from typing import Dict, Iterable, List, Optional, Tuple
+from ..utils.locktrace import mutex
 
 # label set -> canonical picklable key: sorted ((k, v), ...) string pairs
 LabelsKey = Tuple[Tuple[str, str], ...]
@@ -96,7 +97,7 @@ class _CounterSeries:
     def __init__(self) -> None:
         self._local = threading.local()
         self._cells: List[list] = []
-        self._mu = threading.Lock()
+        self._mu = mutex()
         self._absorbed = 0.0
 
     def inc(self, v: float = 1.0) -> None:
@@ -124,7 +125,7 @@ class _GaugeSeries:
     __slots__ = ("_mu", "_v")
 
     def __init__(self) -> None:
-        self._mu = threading.Lock()
+        self._mu = mutex()
         self._v = 0.0
 
     def set(self, v: float) -> None:
@@ -153,7 +154,7 @@ class _HistSeries:
         self.bounds = bounds
         self._local = threading.local()
         self._cells: List[list] = []
-        self._mu = threading.Lock()
+        self._mu = mutex()
         # absorbed child/merged contributions: counts + [sum]
         self._absorbed = [0] * (len(bounds) + 1) + [0.0]
 
@@ -197,7 +198,7 @@ class _Metric:
         self.name = name
         self.help = help
         self._series_kw = series_kw
-        self._mu = threading.Lock()
+        self._mu = mutex()
         self._series: Dict[LabelsKey, object] = {}
 
     def labels(self, **labels):
@@ -272,7 +273,7 @@ class Registry:
 
     def __init__(self, enabled: Optional[bool] = None) -> None:
         self.enabled = _env_enabled() if enabled is None else enabled
-        self._mu = threading.Lock()
+        self._mu = mutex()
         self._metrics: Dict[str, _Metric] = {}
         self._children: Dict[object, dict] = {}
 

@@ -35,10 +35,11 @@ import os
 import threading
 import time
 from typing import Iterator, List, Optional
+from ..utils.locktrace import mutex
 
 _MAX_EVENTS = 200_000
 
-_mu = threading.Lock()
+_mu = mutex()
 _events: List[dict] = []
 _dropped = 0
 _active = False

@@ -40,6 +40,7 @@ import socket
 import threading
 import time
 from typing import List, Optional
+from ..utils.locktrace import mutex
 
 log = logging.getLogger("difacto_tpu")
 
@@ -114,7 +115,7 @@ class HeartbeatMonitor:
         self._stop = threading.Event()
         self._in_collective_since: Optional[float] = None
         self._collective_depth = 0
-        self._depth_lock = threading.Lock()
+        self._depth_lock = mutex()
         self._threads = [
             threading.Thread(target=self._send_loop, daemon=True),
             threading.Thread(target=self._recv_loop, daemon=True),

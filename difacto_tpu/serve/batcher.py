@@ -33,6 +33,7 @@ import queue
 from ..data.rowblock import RowBlock
 from ..utils import faultinject
 from ..utils.reporter import Reporter
+from ..utils.locktrace import mutex
 
 log = logging.getLogger("difacto_tpu")
 
@@ -59,7 +60,7 @@ class ServeStats:
         from ..obs import Registry
         self.obs = registry if registry is not None \
             else Registry(enabled=True)
-        self._mu = threading.Lock()     # latency window + report throttle
+        self._mu = mutex()              # latency window + report throttle
         self._lat = collections.deque(maxlen=window)  # seconds
         self._t0 = time.monotonic()
         self._last_report = self._t0
@@ -190,7 +191,7 @@ class MicroBatcher:
         self.stats = stats if stats is not None else ServeStats()
         self._q: "queue.Queue" = queue.Queue()
         self._rows_queued = 0          # admission-bounded under _mu
-        self._mu = threading.Lock()
+        self._mu = mutex()
         self._alive = False
         self._busy = False             # a batch is being scored right now
         self._thread: Optional[threading.Thread] = None

@@ -38,6 +38,7 @@ from ..losses import LossSpec, create as create_loss
 from ..ops.batch import pack_batch, unpack_batch
 from ..step import make_predict_fn
 from ..store.local import SlotStore, pad_slots_oob
+from ..utils.locktrace import mutex
 
 
 def sigmoid(pred: np.ndarray) -> np.ndarray:
@@ -69,7 +70,7 @@ class PredictExecutor:
 
         self._packed = jax.jit(packed_predict, static_argnums=(3, 4, 5, 6))
         self._shapes = ShapeSchedule()
-        self._mu = threading.Lock()
+        self._mu = mutex()
         self._buckets: dict = {}   # statics key -> dispatch count
         self._dispatches = 0
         self._warmed = 0           # buckets compiled by warm_bucket()

@@ -53,6 +53,7 @@ import time
 from typing import Optional, Tuple
 
 from ..utils import faultinject, stream
+from ..utils.locktrace import mutex
 
 log = logging.getLogger("difacto_tpu")
 
@@ -74,7 +75,7 @@ class ModelReloader:
         self.bluegreen_swaps = 0
         self.last_warm_ms = 0.0              # wall cost of the last warm
         self.swap_state = "idle"             # idle | warming | swapping
-        self._reload_mu = threading.Lock()   # serialize concurrent reloads
+        self._reload_mu = mutex()            # serialize concurrent reloads
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._cur = self._fingerprint()

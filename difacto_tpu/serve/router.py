@@ -60,6 +60,7 @@ from typing import Dict, List, Optional, Tuple
 from ..config import parse_endpoints
 from ..utils import faultinject
 from .fleethealth import open_blacklist
+from ..utils.locktrace import mutex
 
 log = logging.getLogger("difacto_tpu")
 
@@ -116,7 +117,7 @@ class RouterServer:
             "rows answered !shed because no backend was available")
         self._err_c = self.obs.counter(
             "router_errors_total", "rows rejected at the router")
-        self._mu = threading.Lock()      # backend stats
+        self._mu = mutex()               # backend stats
         self._sock = socket.create_server((host, port))
         self._sock.settimeout(0.25)
         self.host, self.port = self._sock.getsockname()[:2]
@@ -126,7 +127,7 @@ class RouterServer:
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: set = set()
         self._conn_threads: list = []
-        self._cmu = threading.Lock()     # connection bookkeeping
+        self._cmu = mutex()              # connection bookkeeping
 
     # ---------------------------------------------------------- control
     def start(self) -> "RouterServer":

@@ -48,6 +48,7 @@ from ..utils import faultinject, stream
 from ..utils.reporter import Reporter
 from .batcher import MicroBatcher, ServeStats
 from .executor import PredictExecutor, sigmoid
+from ..utils.locktrace import mutex
 
 log = logging.getLogger("difacto_tpu")
 
@@ -107,7 +108,7 @@ class ServeServer:
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: set = set()
         self._conn_threads: list = []
-        self._mu = threading.Lock()
+        self._mu = mutex()
 
     # ---------------------------------------------------------- control
     def start(self) -> "ServeServer":

@@ -65,6 +65,7 @@ import signal
 import threading
 import time
 from typing import Dict, List, Optional
+from .locktrace import mutex
 
 KINDS = ("err", "truncate", "close", "delay_ms", "kill")
 
@@ -87,7 +88,7 @@ class _Fault:
 
 
 _armed: Dict[str, List[_Fault]] = {}
-_mu = threading.Lock()
+_mu = mutex()
 _rng = random.Random()
 
 

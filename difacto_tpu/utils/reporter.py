@@ -14,12 +14,13 @@ from __future__ import annotations
 
 import threading
 from typing import Any, Callable, Optional
+from .locktrace import mutex
 
 
 class Reporter:
     def __init__(self, every: int = 1):
         self._monitor: Optional[Callable[[int, Any], None]] = None
-        self._mu = threading.Lock()
+        self._mu = mutex()
         self._count = 0
         self._every = max(every, 1)
 

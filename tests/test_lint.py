@@ -196,6 +196,24 @@ LOCAL_FIXTURES = [
         def host(a):
             return np.sum(a)
      """),
+    ("cond-wait-while", """
+        import threading
+        cond = threading.Condition()
+        def f(ready):
+            with cond:
+                if not ready:
+                    cond.wait()
+     """, """
+        import threading
+        cond = threading.Condition()
+        def ok_while(ready):
+            with cond:
+                while not ready():
+                    cond.wait()
+        def ok_wait_for(ready):
+            with cond:
+                cond.wait_for(ready)
+     """),
 ]
 
 
@@ -402,6 +420,422 @@ def test_config_registry_undeclared_knob_and_env(tmp_path):
         "docs/conf.md": "knobs: declared_knob, DIFACTO_SECRET\n",
     })
     assert core.run_project(proj, ["config-registry"]).active == []
+
+
+# ---------------------------------------------------------------------------
+# interprocedural concurrency rules (analysis/concurrency.py)
+
+
+def test_lock_order_cycle_detected_single_file(tmp_path):
+    found = lint_src(tmp_path, """
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def fwd():
+            with A:
+                with B:
+                    pass
+        def rev():
+            with B:
+                with A:
+                    pass
+     """, ["lock-order"])
+    assert len(found) == 1
+    msg = found[0].message
+    assert "lock-order cycle" in msg
+    # BOTH witness paths ride the finding
+    assert "fwd" in msg and "rev" in msg
+
+
+def test_lock_order_consistent_order_is_clean(tmp_path):
+    assert lint_src(tmp_path, """
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def f():
+            with A:
+                with B:
+                    pass
+        def g():
+            with A:
+                with B:
+                    pass
+     """, ["lock-order"]) == []
+
+
+def test_lock_order_suppression(tmp_path):
+    src = textwrap.dedent("""
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def fwd():
+            with A:  # lint: ok(lock-order) fixture
+                with B:
+                    pass
+        def rev():
+            with B:
+                with A:
+                    pass
+    """)
+    (tmp_path / "mod.py").write_text(src)
+    res = core.run_project(core.Project(tmp_path, ["mod.py"]),
+                           ["lock-order"])
+    assert res.active == []
+    assert sum(f.suppressed for f in res.findings) == 1
+
+
+def test_lock_order_interprocedural_deadlock_package(tmp_path):
+    """The synthetic two-lock deadlock: m1 takes A then calls into m2
+    which takes B; m2 also takes B then calls back into m1 for A. The
+    cycle spans modules — only the call graph can see it."""
+    proj = make_project(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/m1.py": """
+            import threading
+            from pkg import m2
+            A = threading.Lock()
+            def outer():
+                with A:
+                    m2.take_b()
+            def take_a():
+                with A:
+                    pass
+        """,
+        "pkg/m2.py": """
+            import threading
+            from pkg import m1
+            B = threading.Lock()
+            def take_b():
+                with B:
+                    pass
+            def rev():
+                with B:
+                    m1.take_a()
+        """,
+    })
+    found = core.run_project(proj, ["lock-order"]).active
+    assert len(found) == 1
+    msg = found[0].message
+    assert "m1.py::A" in msg and "m2.py::B" in msg
+    assert "outer" in msg and "rev" in msg  # one witness per direction
+
+
+def test_lock_order_thread_target_does_not_propagate(tmp_path):
+    """Held locks stop at a Thread(target=...) hand-off: the target
+    runs on another thread, so A-held-while-spawning does not order A
+    before anything the spawned thread takes."""
+    assert lint_src(tmp_path, """
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+        def take_b():
+            with B:
+                pass
+        def take_a():
+            with A:
+                pass
+        def spawn():
+            with A:
+                t = threading.Thread(target=take_b, daemon=True)
+                t.start()
+        def rev():
+            with B:
+                take_a()
+     """, ["lock-order"]) == []
+
+
+def test_lock_blocking_direct_and_negative(tmp_path):
+    found = lint_src(tmp_path, """
+        import threading
+        L = threading.Lock()
+        def f(conn):
+            with L:
+                conn.sendall(b"x")
+     """, ["lock-blocking"])
+    assert len(found) == 1 and "sendall" in found[0].message
+
+    assert lint_src(tmp_path, """
+        import threading
+        import queue
+        L = threading.Lock()
+        q = queue.Queue()
+        def ok_outside(conn):
+            with L:
+                pass
+            conn.sendall(b"x")
+        def ok_timed():
+            with L:
+                return q.get(timeout=0.1)
+        def ok_nowait():
+            with L:
+                q.put_nowait(1)
+     """, ["lock-blocking"]) == []
+
+
+def test_lock_blocking_queue_without_timeout(tmp_path):
+    found = lint_src(tmp_path, """
+        import threading
+        import queue
+        L = threading.Lock()
+        q = queue.Queue()
+        def f():
+            with L:
+                return q.get()
+     """, ["lock-blocking"])
+    assert len(found) == 1
+    assert "queue.get() without timeout" in found[0].message
+
+
+def test_lock_blocking_interprocedural(tmp_path):
+    found = lint_src(tmp_path, """
+        import threading
+        import time
+        L = threading.Lock()
+        def helper():
+            time.sleep(0.1)
+        def f():
+            with L:
+                helper()
+     """, ["lock-blocking"])
+    assert len(found) == 1
+    msg = found[0].message
+    assert "time.sleep" in msg and "helper" in msg
+
+
+def test_lock_blocking_suppression(tmp_path):
+    src = textwrap.dedent("""
+        import threading
+        L = threading.Lock()
+        def f(conn):
+            with L:
+                conn.sendall(b"x")  # lint: ok(lock-blocking) fixture
+    """)
+    (tmp_path / "mod.py").write_text(src)
+    res = core.run_project(core.Project(tmp_path, ["mod.py"]),
+                           ["lock-blocking"])
+    assert res.active == []
+    assert sum(f.suppressed for f in res.findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# --changed-only incremental mode
+
+
+def test_changed_only_limits_local_rules_not_cross(tmp_path, capsys):
+    """Local rules narrow to changed files; the concurrency rules still
+    see the whole tree (a cycle in an UNCHANGED file must still fail)."""
+    import subprocess
+    root = tmp_path / "repo"
+    root.mkdir()
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-C", str(root), "-c", "user.email=t@t",
+             "-c", "user.name=t", *args],
+            check=True, capture_output=True)
+
+    (root / "a.py").write_text(textwrap.dedent("""
+        import threading
+        import time
+        T = time.time()
+        A = threading.Lock()
+        B = threading.Lock()
+        def fwd():
+            with A:
+                with B:
+                    pass
+        def rev():
+            with B:
+                with A:
+                    pass
+    """))
+    (root / "b.py").write_text("import time\nU = time.monotonic()\n")
+    git("init", "-q")
+    git("add", ".")
+    git("commit", "-q", "-m", "seed")
+    (root / "b.py").write_text("import time\nU = time.time()\n")
+
+    args = ["--root", str(root), ".", "--rules", "wall-clock,lock-order",
+            "--format", "json"]
+    rc = lint_main(args)
+    full = json.loads(capsys.readouterr().out)
+    assert rc == 1 and full["counts"]["active"] == 3  # 2 wall + 1 cycle
+
+    rc = lint_main(args + ["--changed-only"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    by_rule = {}
+    for f in doc["findings"]:
+        by_rule.setdefault(f["rule"], []).append(f["path"])
+    # a.py's wall-clock finding is pre-existing -> skipped; b.py's is
+    # new -> reported; the cycle lives in unchanged a.py -> reported
+    assert by_rule == {"wall-clock": ["b.py"], "lock-order": ["a.py"]}
+
+
+# ---------------------------------------------------------------------------
+# locktrace: the runtime lock sentinel (utils/locktrace.py)
+
+
+def test_locktrace_records_and_roundtrips_across_threads(tmp_path,
+                                                         monkeypatch):
+    import threading
+
+    from difacto_tpu.utils import locktrace
+
+    monkeypatch.setenv("DIFACTO_LOCKTRACE", "1")
+    locktrace.reset()
+    a = locktrace.mutex()
+    b = locktrace.mutex()
+
+    def nest():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=nest, daemon=True)
+    t.start()
+    t.join()
+    nest()  # the main thread takes the same order
+
+    edges = locktrace.edges()
+    assert len(edges) == 1
+    ((src, dst), count), = edges.items()
+    assert count == 2  # one edge per thread, same sites
+    assert src != dst
+    assert all(s.startswith("tests/test_lint.py:") for s in (src, dst))
+    assert locktrace.sites()[src] == "Lock"
+
+    out = tmp_path / "locks.json"
+    locktrace.dump(out)
+    data = locktrace.load(out)
+    assert data["edges"] == edges
+    assert data["sites"][dst] == "Lock"
+    locktrace.reset()
+    assert locktrace.edges() == {}
+
+
+def test_locktrace_release_order_and_disabled(monkeypatch):
+    import threading
+
+    from difacto_tpu.utils import locktrace
+
+    monkeypatch.delenv("DIFACTO_LOCKTRACE", raising=False)
+    raw = locktrace.mutex()
+    assert isinstance(raw, type(threading.Lock()))
+
+    monkeypatch.setenv("DIFACTO_LOCKTRACE", "1")
+    locktrace.reset()
+    a = locktrace.mutex()
+    b = locktrace.mutex()
+    # hand-over-hand: a release between acquires drops the edge source
+    a.acquire()
+    a.release()
+    b.acquire()
+    b.release()
+    assert locktrace.edges() == {}
+    with a:
+        with b:
+            assert b.locked()
+    assert len(locktrace.edges()) == 1
+
+
+def test_locktrace_dynamic_edges_subset_of_static_graph(monkeypatch):
+    """The tier-1 gate: every acquisition-order edge a real execution
+    records must already exist in the static lock-order graph (the
+    static model over-approximates; a dynamic edge it missed is a
+    callgraph blind spot to fix), and the static graph of this tree is
+    cycle-free with an empty baseline."""
+    import numpy as np
+
+    from difacto_tpu.analysis.cli import DEFAULT_PATHS
+    from difacto_tpu.analysis.concurrency import get_model
+    from difacto_tpu.data.rowblock import RowBlock
+    from difacto_tpu.serve.batcher import MicroBatcher, ServeStats
+    from difacto_tpu.utils import locktrace
+
+    monkeypatch.setenv("DIFACTO_LOCKTRACE", "1")
+    locktrace.reset()
+    blk = RowBlock(offset=np.array([0, 1], dtype=np.int64),
+                   label=np.zeros(1, dtype=np.float32),
+                   index=np.zeros(1, dtype=np.uint32),
+                   value=None, weight=None)
+    stats = ServeStats()
+    bat = MicroBatcher(lambda x: np.zeros(x.size, np.float32),
+                       batch_size=2, queue_cap=1, stats=stats)
+    try:
+        assert bat.submit(blk) is not None
+        # second row overflows queue_cap=1: the shed counters tick
+        # UNDER the batcher admission lock — a real nested acquisition
+        assert bat.submit(blk) is None
+        stats.record_latency(0.001)
+        stats.snapshot()
+    finally:
+        bat.close()
+
+    edges = locktrace.edges()
+    assert edges, "the scenario must actually nest traced locks"
+
+    project = core.Project(
+        REPO_ROOT, [p for p in DEFAULT_PATHS if (REPO_ROOT / p).exists()])
+    model = get_model(project)
+    assert model.cycles == [], \
+        f"static lock-order graph has cycles: {model.cycles}"
+    site2lock = {f"{li.path}:{li.line}": lid
+                 for lid, li in model.locks.items()}
+    for a, b in edges:
+        assert a in site2lock, \
+            f"dynamic lock site {a} unknown to the static model"
+        assert b in site2lock, \
+            f"dynamic lock site {b} unknown to the static model"
+        edge = (site2lock[a], site2lock[b])
+        assert edge in model.edges, \
+            f"observed edge {edge} missing from the static graph — " \
+            f"callgraph blind spot"
+
+
+# ---------------------------------------------------------------------------
+# lockmap: merged static + dynamic graph (tools/lockmap.py)
+
+
+def test_lockmap_merges_static_and_dynamic(tmp_path, monkeypatch):
+    import importlib.util
+
+    from difacto_tpu.utils import locktrace
+
+    # tools/ is not a package: load lockmap by path
+    spec = importlib.util.spec_from_file_location(
+        "difacto_lockmap", REPO_ROOT / "tools" / "lockmap.py")
+    lockmap = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lockmap)
+
+    monkeypatch.setenv("DIFACTO_LOCKTRACE", "1")
+    locktrace.reset()
+    import numpy as np
+
+    from difacto_tpu.data.rowblock import RowBlock
+    from difacto_tpu.serve.batcher import MicroBatcher, ServeStats
+    blk = RowBlock(offset=np.array([0, 1], dtype=np.int64),
+                   label=np.zeros(1, dtype=np.float32),
+                   index=np.zeros(1, dtype=np.uint32),
+                   value=None, weight=None)
+    bat = MicroBatcher(lambda x: np.zeros(x.size, np.float32),
+                       batch_size=2, queue_cap=1, stats=ServeStats())
+    try:
+        bat.submit(blk)
+        bat.submit(blk)
+    finally:
+        bat.close()
+    dump = tmp_path / "trace.json"
+    locktrace.dump(dump)
+
+    graph = lockmap.build(REPO_ROOT, dump)
+    assert graph["cycles"] == []
+    assert graph["dynamic_only"] == []
+    assert graph["confirmed"], "dynamic edges must confirm static ones"
+    dot = lockmap.to_dot(graph)
+    assert "digraph lockmap" in dot and "confirmed" in dot
+    doc = lockmap.to_json(graph)
+    assert doc["dynamic_edges"] and doc["locks"]
 
 
 # ---------------------------------------------------------------------------

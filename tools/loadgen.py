@@ -42,6 +42,7 @@ import time
 from typing import List, Sequence, Union
 
 import numpy as np
+from difacto_tpu.utils.locktrace import mutex
 
 Line = Union[str, bytes]
 
@@ -68,7 +69,7 @@ def run_loadgen(host: str, port: int, rows: Sequence[Line], qps: float,
     rfile = sock.makefile("rb")
 
     send_ts: List[float] = []      # monotonic send time per request
-    ts_lock = threading.Lock()
+    ts_lock = mutex()
     sent = 0
 
     def sender() -> None:
