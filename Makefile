@@ -44,7 +44,8 @@ lint-changed:
 lint-baseline:
 	$(PY) tools/lint.py --write-baseline
 
-# merged static+dynamic lock-order graph (docs/static_analysis.md):
+# merged static+dynamic lock-order graph, with each lock labeled by the
+# fields the race pass proves it guards (docs/static_analysis.md):
 #   make lockmap                          # static model only
 #   make lockmap LOCKTRACE=run.locks.json # + a DIFACTO_LOCKTRACE_OUT dump
 LOCKTRACE ?=

@@ -26,10 +26,15 @@ Layout:
   lock (``lock-blocking``), Condition-wait discipline
   (``cond-wait-while``); the static half of the lock sentinel
   (utils/locktrace.py is the runtime half, tools/lockmap.py the
-  merged view).
+  merged view). Its one walk per function also records the shared-
+  state accesses the race pass reads.
+- :mod:`races`       — Eraser-style data-race detection (``data-race``):
+  thread-root discovery, the shared-state index, per-field lockset
+  intersection and GuardedBy inference; the static half of the
+  shared-state sentinel (utils/shared.py is the runtime half).
 - :mod:`cli`         — ``python -m difacto_tpu.analysis`` /
   ``tools/lint.py`` / ``make lint`` (``--changed-only`` for the
-  incremental loop).
+  incremental loop; ``--format=sarif`` for code scanning).
 """
 
 from .core import Finding, Project, all_rules, run_project  # noqa: F401

@@ -27,11 +27,18 @@ possible targets:
   constructors, ``pool.submit(f, ...)`` and ``pool.map(f, it)`` — are
   separate ``thread``-kind edges: the target runs on another thread, so
   callers must NOT propagate held locks across them (the concurrency
-  pass treats them as reachability-only).
+  pass treats them as reachability-only). The target REFERENCE resolves
+  through every form the tree actually uses: a plain name, ``self.m`` /
+  ``mod.f`` dotted refs, ``functools.partial(f, ...)`` (the first
+  positional is the callee), ``lambda: f(...)`` (every call inside the
+  lambda body is a target), and a local alias (``run = self._loop;
+  Thread(target=run)`` — single-assignment locals are chased one level
+  at a time up to a small depth cap).
 
 Known blind spots (documented in docs/static_analysis.md): calls through
-variables holding functions, ``super()`` chains, ``getattr`` dispatch,
-and decorator indirection all resolve to nothing.
+variables holding functions (other than the single-assignment thread-
+target aliases above), ``super()`` chains, ``getattr`` dispatch, and
+decorator indirection all resolve to nothing.
 """
 
 from __future__ import annotations
@@ -56,6 +63,7 @@ BUILTIN_SHADOWED = frozenset({
     "split", "rsplit", "strip", "lstrip", "rstrip", "partition",
     "startswith", "endswith", "encode", "decode", "format", "lower",
     "upper", "replace", "find", "rfind", "search", "match", "group",
+    "resolve",  # pathlib.Path.resolve on every checkpoint/config path
 })
 
 _FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
@@ -85,6 +93,10 @@ class CallSite:
     node: ast.Call
     kind: str                       # "call" | "thread"
     targets: Tuple[str, ...]        # resolved FuncInfo quals
+    # True when the targets came from the MULTI-candidate attribute
+    # heuristic: a safe over-approximation for lock-order analysis, but
+    # the race pass must not smear thread-root reachability through it
+    fuzzy: bool = False
 
 
 def _module_name(rel: str) -> str:
@@ -112,6 +124,7 @@ class CallGraph:
         self.owner_of: Dict[int, str] = {}   # id(ast node) -> func qual
         self.ambiguous: Dict[str, int] = {}  # method name -> defs (over cap)
         self._def_qual: Dict[int, str] = {}  # id(def node) -> qual
+        self._fuzzy = False   # sticky per-call-site heuristic marker
         self._imports: Dict[str, Tuple[Dict[str, str],
                                        Dict[str, Tuple[str, str]]]] = {}
         for sf in project.files:
@@ -178,7 +191,7 @@ class CallGraph:
         my_pkg_parts = my_mod.split(".")
         if not sf.rel.endswith("__init__.py"):
             my_pkg_parts = my_pkg_parts[:-1]
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if isinstance(node, ast.Import):
                 for a in node.names:
                     if a.asname:
@@ -234,7 +247,12 @@ class CallGraph:
         if len(quals) > MAX_METHOD_FANOUT:
             self.ambiguous[method] = len(quals)
             return ()
-        return tuple(sorted(set(quals)))
+        out = tuple(sorted(set(quals)))
+        if len(out) > 1:
+            # several same-named candidates: over-approximation, marked
+            # so CallSite.fuzzy reaches the race pass
+            self._fuzzy = True
+        return out
 
     def _resolve_name(self, sf: SourceFile, owner_qual: str,
                       name: str) -> Tuple[str, ...]:
@@ -339,15 +357,62 @@ class CallGraph:
         return ("call", self._resolve_dotted(sf, owner, cn))
 
     def _resolve_ref(self, sf: SourceFile, owner: FuncInfo,
-                     expr) -> Tuple[str, ...]:
-        """Resolve a function REFERENCE (thread target, submit arg)."""
+                     expr, depth: int = 0) -> Tuple[str, ...]:
+        """Resolve a function REFERENCE (thread target, submit arg):
+        names, ``self.m``/``mod.f`` attributes, ``functools.partial``
+        wrappers, lambdas, and single-assignment local aliases."""
+        if depth > 3:
+            return ()
         if isinstance(expr, ast.Name):
-            return self._resolve_name(sf, owner.qual, expr.id)
+            got = self._resolve_name(sf, owner.qual, expr.id)
+            if got:
+                return got
+            alias = self._local_alias(owner, expr.id)
+            if alias is not None:
+                return self._resolve_ref(sf, owner, alias, depth + 1)
+            return ()
         if isinstance(expr, ast.Attribute):
             dn = dotted(expr)
             if dn:
                 return self._resolve_dotted(sf, owner, dn)
+            return ()
+        if isinstance(expr, ast.Lambda):
+            # `target=lambda: f(x)` — the lambda runs on the new thread,
+            # so every call inside its body is a thread target (the
+            # lambda shares the owner's lexical scope for resolution)
+            out: List[str] = []
+            for node in ast.walk(expr.body):
+                if isinstance(node, ast.Call):
+                    _kind, tgts = self.resolve(sf, owner, node)
+                    out.extend(tgts)
+            return tuple(sorted(set(out)))
+        if isinstance(expr, ast.Call):
+            cn = dotted(expr.func)
+            if cn and (cn == "partial" or cn.endswith(".partial")) \
+                    and expr.args:
+                return self._resolve_ref(sf, owner, expr.args[0],
+                                         depth + 1)
         return ()
+
+    def _local_alias(self, owner: FuncInfo, name: str):
+        """The value of the LAST single-target ``name = <expr>`` in the
+        owner function, when the value is a plausible callable reference
+        (name/attribute/partial/lambda). Conservative: only one binding
+        shape is chased; anything fancier stays unresolved."""
+        node = owner.node
+        if node is None:
+            return None
+        found = None
+        for stmt in ast.walk(node):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id == name \
+                    and isinstance(stmt.value, (ast.Name, ast.Attribute,
+                                                ast.Lambda, ast.Call)):
+                found = stmt.value
+        if isinstance(found, ast.Name) and found.id == name:
+            return None
+        return found
 
     # -------------------------------------------------------- call sites
     def _collect_calls(self, sf: SourceFile) -> None:
@@ -370,14 +435,15 @@ class CallGraph:
         tag(sf.tree, mod_q)
         # class bodies re-tag: methods' quals were computed in
         # _collect_defs; _qual_of_def reuses them via a reverse lookup
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if not isinstance(node, ast.Call):
                 continue
             owner_qual = self.owner_of.get(id(node), mod_q)
             owner = self.funcs.get(owner_qual) \
                 or self.funcs[mod_q]
+            self._fuzzy = False
             kind, targets = self.resolve(sf, owner, node)
-            site = CallSite(node, kind, targets)
+            site = CallSite(node, kind, targets, self._fuzzy)
             self.calls.setdefault(owner.qual, []).append(site)
             self.by_node[id(node)] = site
         for sites in self.calls.values():

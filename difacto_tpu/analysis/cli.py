@@ -31,7 +31,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
     p.add_argument("--root", default=".",
                    help="project root (docs/, tests/, baseline live here)")
-    p.add_argument("--format", choices=("text", "json", "github"),
+    p.add_argument("--format",
+                   choices=("text", "json", "github", "sarif"),
                    default="text", dest="fmt")
     p.add_argument("--baseline", default=None,
                    help=f"baseline file (default: <root>/{DEFAULT_BASELINE} "
@@ -148,6 +149,8 @@ def main(argv=None) -> int:
         print(core.format_json(res))
     elif args.fmt == "github":
         print(core.format_github(res))
+    elif args.fmt == "sarif":
+        print(core.format_sarif(res))
     else:
         print(core.format_text(res, verbose=args.verbose))
     return 1 if res.active else 0

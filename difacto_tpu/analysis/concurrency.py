@@ -94,7 +94,7 @@ def _bare_lock_names(sf: SourceFile) -> Dict[str, str]:
     """Names bound by ``from threading import Lock`` /
     ``from ..utils.locktrace import mutex`` — local name -> kind."""
     out: Dict[str, str] = {}
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, ast.ImportFrom) and node.module:
             if node.module.split(".")[-1] in _LOCK_MODULES:
                 for a in node.names:
@@ -119,7 +119,7 @@ def discover_locks(sf: SourceFile, cg: Optional[CallGraph] = None) \
         return []
     bare = _bare_lock_names(sf)
     out: List[LockInfo] = []
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if not isinstance(node, (ast.Assign, ast.AnnAssign)):
             continue
         value = node.value
@@ -194,7 +194,7 @@ _TYPE_CTORS = {"Queue": "queue", "SimpleQueue": "queue",
 
 def _typed_keys(sf: SourceFile) -> Dict[str, str]:
     types: Dict[str, str] = {}
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if not isinstance(node, ast.Call):
             continue
         last = call_name(node).split(".")[-1]
@@ -298,6 +298,16 @@ class _FuncFacts:
     # (held, desc, node) — blocking op with a lock held, in THIS body
     block_events: List[Tuple[Tuple[Tuple[str, str], ...], str,
                              ast.Call]] = field(default_factory=list)
+    # shared-state accesses for the race pass (analysis/races.py):
+    # (held lock ids, node) where node is a `self.attr`/`cls.attr`
+    # Attribute, or a Name that is free / global / nonlocal / a closure
+    # cell in this scope — recorded in the SAME walk that tracks held
+    # sets, so the race pass never re-walks a function body
+    access_events: List[Tuple[Tuple[str, ...], ast.AST]] = \
+        field(default_factory=list)
+    local_names: Set[str] = field(default_factory=set)
+    global_names: Set[str] = field(default_factory=set)
+    cell_names: Set[str] = field(default_factory=set)
 
 
 class _Scanner:
@@ -312,6 +322,62 @@ class _Scanner:
         self.time_names = model.file_time_names[fi.sf.rel]
         self.subprocess_names = model.file_subprocess_names[fi.sf.rel]
         self.cond_keys = model.file_cond_keys[fi.sf.rel]
+        self._scope_names()
+
+    def _scope_names(self) -> None:
+        """Name classification for the race pass: names bound in THIS
+        scope (locals), ``global``/``nonlocal`` declarations, and
+        closure cells (locals a nested def also references)."""
+        node = self.fi.node
+        body = node.body if node is not None else self.sf.tree.body
+        locs: Set[str] = set()
+        gl: Set[str] = set()
+        nl: Set[str] = set()
+        nested: List[ast.AST] = []
+        if node is not None and isinstance(node, _FUNC_DEFS):
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                locs.add(arg.arg)
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _SCOPES):
+                if isinstance(n, (*_FUNC_DEFS, ast.ClassDef)):
+                    locs.add(n.name)
+                nested.append(n)
+                continue
+            if isinstance(n, ast.Global):
+                gl.update(n.names)
+                continue
+            if isinstance(n, ast.Nonlocal):
+                nl.update(n.names)
+                continue
+            if isinstance(n, ast.Name) \
+                    and isinstance(n.ctx, (ast.Store, ast.Del)):
+                locs.add(n.id)
+            elif isinstance(n, (ast.Import, ast.ImportFrom)):
+                for al in n.names:
+                    locs.add((al.asname or al.name).split(".")[0])
+            elif isinstance(n, ast.ExceptHandler) and n.name:
+                locs.add(n.name)
+            stack.extend(ast.iter_child_nodes(n))
+        locs -= gl | nl
+        used_below: Set[str] = set()
+        for sub in nested:
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Name):
+                    used_below.add(n.id)
+                elif isinstance(n, ast.Nonlocal):
+                    used_below.update(n.names)
+        self._locals = locs
+        self._globals = gl
+        self._nonlocals = nl
+        self._cells = locs & used_below
+        self.facts.local_names = locs
+        self.facts.global_names = gl
+        self.facts.cell_names = self._cells
 
     def _site(self, node) -> str:
         return f"{self.sf.rel}:{getattr(node, 'lineno', 0)}"
@@ -433,6 +499,21 @@ class _Scanner:
     def visit_node(self, node, held: List[Tuple[str, str]]) -> None:
         if isinstance(node, _SCOPES):
             return  # separate scope: scanned with its own empty held set
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in ("self", "cls") \
+                and not (node.attr.startswith("__")
+                         and node.attr.endswith("__")):
+            self.facts.access_events.append(
+                (tuple(h for h, _ in held), node))
+        elif isinstance(node, ast.Name):
+            nid = node.id
+            if nid in self._globals or nid in self._nonlocals \
+                    or nid in self._cells \
+                    or (nid not in self._locals
+                        and isinstance(node.ctx, ast.Load)):
+                self.facts.access_events.append(
+                    (tuple(h for h, _ in held), node))
         if isinstance(node, (ast.With, ast.AsyncWith)):
             inner = list(held)
             for item in node.items:
@@ -704,7 +785,7 @@ class ConcurrencyModel:
 
 def _module_names(sf: SourceFile, module: str) -> Set[str]:
     out = set()
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name == module:
@@ -850,7 +931,7 @@ def check_lock_blocking(project: Project) -> List[Finding]:
 def check_cond_wait(sf: SourceFile) -> List[Finding]:
     bare = _bare_lock_names(sf)
     cond_keys = set()
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, (ast.Assign, ast.AnnAssign)):
             value = node.value
             if isinstance(value, ast.Call) \
@@ -862,7 +943,7 @@ def check_cond_wait(sf: SourceFile) -> List[Finding]:
                     if key:
                         cond_keys.add(key)
     out = []
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if not (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
                 and node.func.attr == "wait"

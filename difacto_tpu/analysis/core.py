@@ -89,7 +89,7 @@ def rule(rule_id: str, summary: str, cross: bool = False):
 
 def all_rules() -> Dict[str, Rule]:
     # import for side effect: the @rule decorators populate RULES
-    from . import concurrency, crossrules, localrules  # noqa: F401
+    from . import concurrency, crossrules, localrules, races  # noqa: F401
     return RULES
 
 
@@ -102,7 +102,12 @@ _SUPPRESS_RE = re.compile(r"#\s*lint:\s*ok\(([a-zA-Z0-9_\-, ]+)\)")
 class SourceFile:
     """One parsed lint target: text, AST with ``.parent`` links, and the
     per-line suppression map (a pragma covers its own line and, when it
-    stands alone, the first code line after it)."""
+    stands alone, the first code line after it).
+
+    The node index is SHARED: :meth:`walk` / :meth:`call_nodes` cache
+    the flat node list once, so the local, cross, concurrency, and race
+    passes all read one traversal instead of each re-walking the tree
+    (the whole-file ``ast.walk`` was the analyzer's hottest loop)."""
 
     def __init__(self, path: Path, rel: str, text: str):
         self.path = path
@@ -110,13 +115,17 @@ class SourceFile:
         self.text = text
         self.lines = text.splitlines()
         self.parse_error: Optional[str] = None
+        self._nodes: Optional[List[ast.AST]] = None
+        self._calls: Optional[List[ast.Call]] = None
         try:
             self.tree: Optional[ast.AST] = ast.parse(text)
         except SyntaxError as e:
             self.tree = None
             self.parse_error = f"{e.msg} (line {e.lineno})"
         if self.tree is not None:
-            for node in ast.walk(self.tree):
+            nodes = list(ast.walk(self.tree))
+            self._nodes = nodes
+            for node in nodes:
                 for child in ast.iter_child_nodes(node):
                     child.parent = node  # type: ignore[attr-defined]
         self.suppressions: Dict[int, set] = {}
@@ -127,11 +136,27 @@ class SourceFile:
             ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
             self.suppressions.setdefault(i, set()).update(ids)
             if line.lstrip().startswith("#"):
-                # standalone pragma: covers the next code line too
+                # standalone pragma: covers the next CODE line — blank
+                # lines and the rationale's continuation comment lines
+                # in between don't break the attachment
                 j = i + 1
-                while j <= len(self.lines) and not self.lines[j - 1].strip():
+                while j <= len(self.lines) \
+                        and (not self.lines[j - 1].strip()
+                             or self.lines[j - 1].lstrip().startswith("#")):
                     j += 1
                 self.suppressions.setdefault(j, set()).update(ids)
+
+    def walk(self) -> List[ast.AST]:
+        """Every node of the file's AST, computed once (same order as
+        ``ast.walk(self.tree)``). Empty for unparsable files."""
+        return self._nodes or []
+
+    def call_nodes(self) -> List[ast.Call]:
+        """Every ``ast.Call`` in the file, from the shared index."""
+        if self._calls is None:
+            self._calls = [n for n in self.walk()
+                           if isinstance(n, ast.Call)]
+        return self._calls
 
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -352,6 +377,12 @@ class RunResult:
     findings: List[Finding] = field(default_factory=list)
     expired: List[dict] = field(default_factory=list)  # baseline leftovers
     files: int = 0
+    # per-pass wall time: rule id -> seconds (cross rules measured once,
+    # local rules summed across files), plus the analyzer total — the CI
+    # JSON report carries both so the 30s budget can be attributed when
+    # it tightens
+    rule_seconds: Dict[str, float] = field(default_factory=dict)
+    lint_seconds: float = 0.0
 
     @property
     def active(self) -> List[Finding]:
@@ -376,6 +407,8 @@ def run_project(project: Project,
     ``--changed-only`` incremental mode; cross-file and concurrency
     rules always see the whole tree (their findings can live in files
     the change never touched)."""
+    import time as _time
+
     rules = all_rules()
     if rule_ids is not None:
         unknown = set(rule_ids) - set(rules)
@@ -383,6 +416,7 @@ def run_project(project: Project,
             raise ValueError(f"unknown rule(s): {sorted(unknown)}")
         rules = {rid: rules[rid] for rid in rule_ids}
     res = RunResult(files=len(project.files))
+    t_run0 = _time.monotonic()
     by_file = {sf.rel: sf for sf in project.files}
     for sf in project.files:
         if local_files is not None and sf.rel not in local_files:
@@ -394,10 +428,18 @@ def run_project(project: Project,
             continue
         for r in rules.values():
             if not r.cross:
+                t0 = _time.monotonic()
                 res.findings.extend(r.check(sf))
+                res.rule_seconds[r.rule_id] = \
+                    res.rule_seconds.get(r.rule_id, 0.0) \
+                    + (_time.monotonic() - t0)
     for r in rules.values():
         if r.cross:
+            t0 = _time.monotonic()
             res.findings.extend(r.check(project))
+            res.rule_seconds[r.rule_id] = \
+                res.rule_seconds.get(r.rule_id, 0.0) \
+                + (_time.monotonic() - t0)
     # stable order, then occurrence indices for identical snippets
     res.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     seen: Dict[Tuple[str, str, str], int] = {}
@@ -408,6 +450,7 @@ def run_project(project: Project,
         sf = by_file.get(f.path)
         if sf is not None and sf.is_suppressed(f):
             f.suppressed = True
+    res.lint_seconds = _time.monotonic() - t_run0
     return res
 
 
@@ -474,7 +517,12 @@ def format_text(res: RunResult, verbose: bool = False) -> str:
     c = res.counts()
     out.append(f"difacto-lint: {c['files']} files, {c['active']} finding(s) "
                f"({c['suppressed']} suppressed, {c['baselined']} baselined, "
-               f"{c['expired_baseline']} expired baseline)")
+               f"{c['expired_baseline']} expired baseline) "
+               f"in {res.lint_seconds:.2f}s")
+    if verbose and res.rule_seconds:
+        slow = sorted(res.rule_seconds.items(), key=lambda kv: -kv[1])[:6]
+        out.append("slowest passes: " + ", ".join(
+            f"{rid} {s:.2f}s" for rid, s in slow))
     return "\n".join(out)
 
 
@@ -484,6 +532,58 @@ def format_json(res: RunResult) -> str:
         "counts": res.counts(),
         "findings": [f.to_json() for f in res.findings],
         "expired_baseline": res.expired,
+        "lint_seconds": round(res.lint_seconds, 3),
+        "rule_seconds": {rid: round(s, 3)
+                         for rid, s in sorted(res.rule_seconds.items())},
+    }, indent=1, sort_keys=True)
+
+
+def format_sarif(res: RunResult) -> str:
+    """SARIF 2.1.0 — what GitHub code scanning ingests (the CI lint job
+    uploads this next to the JSON report, so findings land as scanning
+    alerts alongside the inline `github`-format annotations). Active
+    findings only; the line-number-free fingerprint rides along as the
+    partial fingerprint so alerts track across unrelated edits."""
+    rules = all_rules()
+    used = sorted({f.rule for f in res.active})
+    results = []
+    for f in res.active:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+            "partialFingerprints": {
+                "difactoLint/v1": f.fingerprint(),
+            },
+        })
+    driver = {
+        "name": "difacto-lint",
+        "informationUri":
+            "https://github.com/difacto-tpu/difacto-tpu"
+            "/blob/main/docs/static_analysis.md",
+        "rules": [{
+            "id": rid,
+            "shortDescription": {
+                "text": rules[rid].summary if rid in rules
+                else "analyzer-internal finding"},
+        } for rid in used],
+    }
+    return json.dumps({
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": driver},
+            "results": results,
+            "columnKind": "utf16CodeUnits",
+        }],
     }, indent=1, sort_keys=True)
 
 

@@ -44,7 +44,7 @@ def _fire_sites(project: Project) -> List[Tuple[str, SourceFile, ast.Call]]:
     for sf in project.files:
         if sf.tree is None or sf.rel == project.kinds_file:
             continue
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if not isinstance(node, ast.Call):
                 continue
             cn = call_name(node)
@@ -135,7 +135,7 @@ def check_metric_registry(project: Project) -> List[Finding]:
     for sf in project.files:
         if sf.tree is None or sf.rel in project.metrics_impl_files:
             continue
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if not isinstance(node, ast.Call) or not node.args:
                 continue
             cn = call_name(node)
@@ -177,7 +177,7 @@ def _control_literals(files: List[SourceFile]) \
     for sf in files:
         if sf.tree is None:
             continue
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             s = str_const(node)
             if s is None:
                 continue
@@ -231,7 +231,7 @@ def _declared_params(project: Project) -> Set[str]:
     for sf in project.files:
         if sf.tree is None:
             continue
-        for node in ast.walk(sf.tree):
+        for node in sf.walk():
             if not isinstance(node, ast.ClassDef):
                 continue
             if not any(dotted(b).split(".")[-1].endswith("Param")
@@ -253,7 +253,7 @@ def _kwargs_read_keys(sf: SourceFile) -> List[Tuple[str, ast.Compare]]:
     <kwargs-ish>`` iteration — the raw config-read pattern."""
     reads = []
     loops = []
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if isinstance(node, (ast.For, ast.comprehension)):
             tgt, it = node.target, node.iter
             if isinstance(tgt, ast.Tuple) and tgt.elts \
@@ -283,7 +283,7 @@ def _kwargs_read_keys(sf: SourceFile) -> List[Tuple[str, ast.Compare]]:
 
 def _env_reads(sf: SourceFile) -> List[Tuple[str, ast.AST]]:
     out = []
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         name: Optional[str] = None
         if isinstance(node, ast.Call):
             cn = call_name(node)

@@ -20,6 +20,10 @@ from .core import (Finding, SourceFile, call_name, dotted,
 
 
 def _walk_calls(tree) -> List[ast.Call]:
+    """Call nodes of a tree — or of a whole SourceFile, in which case
+    the file's shared node index is reused instead of re-walking."""
+    if isinstance(tree, SourceFile):
+        return tree.call_nodes()
     return [n for n in ast.walk(tree) if isinstance(n, ast.Call)]
 
 
@@ -63,14 +67,14 @@ def _in_withitem(node) -> bool:
       "threads must be created with daemon= or joined")
 def check_thread_daemon(sf: SourceFile) -> List[Finding]:
     out = []
-    for call in _walk_calls(sf.tree):
+    for call in _walk_calls(sf):
         cn = call_name(call)
         if not (cn == "Thread" or cn.endswith(".Thread")):
             continue
         if any(kw.arg == "daemon" for kw in call.keywords):
             continue
         key = _assign_key(call)
-        if key and _method_calls_on(sf.tree, key, {"join"}):
+        if key and _method_calls_on(sf, key, {"join"}):
             continue
         out.append(sf.finding(
             "thread-daemon", call,
@@ -84,7 +88,7 @@ def check_thread_daemon(sf: SourceFile) -> List[Finding]:
       "Lock.acquire() needs `with lock:` or finally: release()")
 def check_lock_release(sf: SourceFile) -> List[Finding]:
     out = []
-    for call in _walk_calls(sf.tree):
+    for call in _walk_calls(sf):
         if not (isinstance(call.func, ast.Attribute)
                 and call.func.attr == "acquire"):
             continue
@@ -157,7 +161,7 @@ def _name_escapes(scope, key: str, binder: ast.stmt) -> bool:
       "every path")
 def check_resource_close(sf: SourceFile) -> List[Finding]:
     out = []
-    for call in _walk_calls(sf.tree):
+    for call in _walk_calls(sf):
         cn = call_name(call)
         if not _is_opener(cn):
             continue
@@ -180,7 +184,7 @@ def check_resource_close(sf: SourceFile) -> List[Finding]:
         if key.startswith("."):
             # self/obj attribute: accept when the module closes that
             # attribute somewhere (close()/stop() methods, __exit__)
-            if _method_calls_on(sf.tree, key, _CLOSERS):
+            if _method_calls_on(sf, key, _CLOSERS):
                 continue
         else:
             scope = enclosing_function(call) or sf.tree
@@ -202,7 +206,7 @@ def check_wall_clock(sf: SourceFile) -> List[Finding]:
     time_members = {alias for alias, orig in
                     from_imports(sf.tree, "time").items() if orig == "time"}
     out = []
-    for call in _walk_calls(sf.tree):
+    for call in _walk_calls(sf):
         f = call.func
         hit = (isinstance(f, ast.Attribute) and f.attr == "time"
                and isinstance(f.value, ast.Name)
@@ -250,7 +254,7 @@ def _handler_reports(handler: ast.ExceptHandler) -> bool:
       "broad excepts must log-and-count, re-raise, or narrow")
 def check_broad_except(sf: SourceFile) -> List[Finding]:
     out = []
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if not isinstance(node, ast.ExceptHandler):
             continue
         if node.type is None:
@@ -305,7 +309,7 @@ def _is_jit_call(call: ast.Call) -> bool:
 def check_jax_donate(sf: SourceFile) -> List[Finding]:
     # jitted-with-donation wrappers bound to a name in this file
     wrappers: Dict[str, Set[int]] = {}
-    for call in _walk_calls(sf.tree):
+    for call in _walk_calls(sf):
         if not _is_jit_call(call):
             continue
         idx = _donated_indices(call)
@@ -315,7 +319,7 @@ def check_jax_donate(sf: SourceFile) -> List[Finding]:
         if key and not key.startswith("."):
             wrappers[key] = idx
     out = []
-    for call in _walk_calls(sf.tree):
+    for call in _walk_calls(sf):
         name = call_name(call)
         donated = wrappers.get(name)
         if not donated:
@@ -353,7 +357,7 @@ def _jitted_functions(sf: SourceFile) -> List[ast.FunctionDef]:
     """FunctionDefs that are jit targets: decorated with jit /
     partial(jit, ...) or passed by name to a jit(...) call."""
     jit_arg_names: Set[str] = set()
-    for call in _walk_calls(sf.tree):
+    for call in _walk_calls(sf):
         if _is_jit_call(call) and call.args:
             a0 = call.args[0] if call_name(call).endswith("jit") \
                 or call_name(call) == "jit" else \
@@ -361,7 +365,7 @@ def _jitted_functions(sf: SourceFile) -> List[ast.FunctionDef]:
             if isinstance(a0, ast.Name):
                 jit_arg_names.add(a0.id)
     out = []
-    for node in ast.walk(sf.tree):
+    for node in sf.walk():
         if not isinstance(node, ast.FunctionDef):
             continue
         jitted = node.name in jit_arg_names

@@ -547,6 +547,8 @@ class SGDLearner(Learner):
                 state, *pb, b_cap, width, u_cap, has_cnt, binary)
             return state, o1, a1, o2, a2
 
+        # lint: ok(data-race) written once in _build_steps before any
+        # warm-pool thread exists; workers only read the jitted fn
         self._packed_panel_train_chunked2 = jax.jit(
             packed_panel_train_chunked2, donate_argnums=0,
             static_argnums=(3, 4, 5, 6, 7))
@@ -554,6 +556,8 @@ class SGDLearner(Learner):
         # background compile runs / if it failed). Replay pairs ONLY
         # when the executable is ready, so the ~18 s pair compile never
         # lands on an epoch's critical path (_warm_pair_exec).
+        # lint: ok(data-race) dict binding set before the first warm
+        # thread spawns; workers mutate items, never rebind
         self._pair_execs: dict = {}
         # device-side zeroing of the packed f32 counts tail: replayed cache
         # entries must not re-push epoch-0 feature counts

@@ -65,6 +65,7 @@ def set_trace_id(tid: int) -> None:
     """Adopt a parent process's trace id (propagated through
     pack_stream.StreamSpec into producer workers)."""
     global _trace_id
+    # lint: ok(data-race) write-once setup before producer workers span
     _trace_id = int(tid)
 
 
@@ -73,6 +74,7 @@ def start(path: Optional[str] = None,
     """Begin collecting span events. ``path`` (optional) is where
     :func:`save` / the atexit hook writes the Chrome trace JSON."""
     global _active, _path, _trace_id
+    # lint: ok(data-race) GIL-atomic on/off flip; spans tolerate either
     _active = True
     if path:
         _path = path

@@ -31,7 +31,7 @@ import numpy as np
 import queue
 
 from ..data.rowblock import RowBlock
-from ..utils import faultinject
+from ..utils import faultinject, shared
 from ..utils.reporter import Reporter
 from ..utils.locktrace import mutex
 
@@ -53,6 +53,11 @@ class ServeStats:
     wire contract, not optional telemetry — so ``DIFACTO_OBS=off`` only
     disables the default-registry instrumentation, never serving stats.
     """
+
+    # RACETRACE opt-in (utils/shared.py): the statically GuardedBy
+    # fields of this class, traced when DIFACTO_RACETRACE=1
+    _lat = shared.attr()
+    _last_report = shared.attr()
 
     def __init__(self, reporter: Optional[Reporter] = None,
                  report_every_s: float = 30.0, window: int = 8192,
@@ -180,6 +185,13 @@ class MicroBatcher:
     it started with, the next flush dispatches on the replacement.
     """
 
+    # RACETRACE opt-in (utils/shared.py): `_rows_queued`/`_busy` are
+    # statically GuardedBy _mu, `_alive` is a suppressed stop flag —
+    # the tier-1 gate cross-checks real accesses against those facts
+    _rows_queued = shared.attr()
+    _busy = shared.attr()
+    _alive = shared.attr()
+
     def __init__(self, predict_fn: Callable[[RowBlock], np.ndarray],
                  batch_size: int = 256, max_delay_ms: float = 2.0,
                  queue_cap: int = 1024,
@@ -198,6 +210,8 @@ class MicroBatcher:
 
     # ---------------------------------------------------------- control
     def start(self) -> None:
+        # lint: ok(data-race) monotonic stop flag (GIL-atomic bool): the
+        # loop observes the False from close() on its next iteration
         self._alive = True
         self._thread = threading.Thread(target=self._loop,
                                         name="serve-batcher", daemon=True)
@@ -237,13 +251,18 @@ class MicroBatcher:
 
     @property
     def rows_queued(self) -> int:
-        return self._rows_queued
+        with self._mu:
+            return self._rows_queued
 
     @property
     def idle(self) -> bool:
         """No queued rows and no batch mid-score — the drain loop's
-        "all admitted work has resolved" condition (server.drain)."""
-        return self._rows_queued == 0 and not self._busy
+        "all admitted work has resolved" condition (server.drain). One
+        atomic snapshot under ``_mu``: reading the two fields unlocked
+        could observe the decrement of a batch that is not busy YET and
+        report idle with work in flight."""
+        with self._mu:
+            return self._rows_queued == 0 and not self._busy
 
     # ------------------------------------------------------------- loop
     def _collect(self):
@@ -273,15 +292,16 @@ class MicroBatcher:
             batch = self._collect()
             if not batch:
                 continue
-            # busy BEFORE the queued-row decrement: the drain loop must
-            # never observe (rows_queued == 0, busy == False) while this
-            # batch is still unscored
-            self._busy = True
+            # busy BEFORE the queued-row decrement, in one _mu region:
+            # the drain loop must never observe (rows_queued == 0,
+            # busy == False) while this batch is still unscored
+            rows = sum(r for _, _, r in batch)
+            with self._mu:
+                self._busy = True
+                self._rows_queued -= rows
+                depth = self._rows_queued
             try:
-                rows = sum(r for _, _, r in batch)
-                with self._mu:
-                    self._rows_queued -= rows
-                self.stats.record_batch(rows, self._rows_queued)
+                self.stats.record_batch(rows, depth)
                 try:
                     # one attribute read per flush: a concurrent
                     # swap_executor retargets the NEXT flush, never
@@ -300,4 +320,5 @@ class MicroBatcher:
                     o += r
                 self.stats.maybe_report()
             finally:
-                self._busy = False
+                with self._mu:
+                    self._busy = False

@@ -217,8 +217,10 @@ class ServeClient:
         j = healthy[0] if healthy else \
             min(cands, key=lambda k: self._eps[k].down_until)
         if j != i:
+            # lint: ok(data-race) a ServeClient instance is owned by ONE
+            # thread; the roots are distinct instances (counter likewise)
             self.failovers += 1
-        self._cur = j
+        self._cur = j  # lint: ok(data-race) single-owner instance
         a = attempts.get(j, 0)
         if a > 0:
             self._backoff(a - 1, deadline)
@@ -229,12 +231,14 @@ class ServeClient:
                 self._rfile.close()
             except OSError:
                 pass
+            # lint: ok(data-race) single-owner instance (see _failover)
             self._rfile = None
         if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
+            # lint: ok(data-race) single-owner instance (see _failover)
             self._sock = None
 
     def _ensure_conn(self, deadline: Optional[float],

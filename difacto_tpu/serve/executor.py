@@ -161,6 +161,8 @@ class PredictExecutor:
                 "must go through the blue/green executor swap "
                 "(serve/reload.py, requires a server-attached reloader)")
         with self._mu:
+            # lint: ok(data-race) atomic reference swap (hot-reload commit
+            # point): predict/warm snapshot self.store once per call
             self.store = store
             self.generation += 1
             return self.generation

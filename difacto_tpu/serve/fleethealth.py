@@ -45,6 +45,13 @@ import time
 from typing import Dict, Optional, Tuple
 
 try:
+    from ..utils.locktrace import mutex
+except ImportError:
+    # tests/fleethealth_worker.py loads this file standalone (no
+    # package) to drive two-process concurrent writers
+    from threading import Lock as mutex
+
+try:
     import fcntl
 except ImportError:  # pragma: no cover - non-POSIX
     fcntl = None
@@ -73,6 +80,10 @@ class FleetHealth:
         self.path = path
         self.down_s = down_s
         self.max_bytes = max_bytes
+        # one handle is polled from every router/client thread: the
+        # fold cache must swap (stamp, cache) atomically or a reader
+        # can pair a fresh stamp with a stale fold
+        self._cache_mu = mutex()
         self._cache_stamp: Optional[Tuple[float, int]] = None
         self._cache: Dict[str, Tuple[str, float]] = {}  # key -> (op, ts)
 
@@ -148,7 +159,8 @@ class FleetHealth:
                          "pid": os.getpid()},
                         separators=(",", ":")) + "\n")
         os.replace(tmp, self.path)
-        self._cache_stamp = None
+        with self._cache_mu:
+            self._cache_stamp = None
 
     # ---------------------------------------------------------- reading
     def _read_lines(self) -> list:
@@ -179,12 +191,14 @@ class FleetHealth:
             st = os.stat(self.path)
             stamp = (st.st_mtime, st.st_size)
         except OSError:
-            self._cache_stamp, self._cache = None, {}
+            with self._cache_mu:
+                self._cache_stamp, self._cache = None, {}
+                return self._cache
+        with self._cache_mu:
+            if stamp != self._cache_stamp:
+                self._cache = self._fold(self._read_lines())
+                self._cache_stamp = stamp
             return self._cache
-        if stamp != self._cache_stamp:
-            self._cache = self._fold(self._read_lines())
-            self._cache_stamp = stamp
-        return self._cache
 
     def down_endpoints(self) -> Dict[str, float]:
         """{'host:port': seconds_remaining} for every endpoint currently

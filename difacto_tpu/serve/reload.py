@@ -161,6 +161,8 @@ class ModelReloader:
                 else:
                     gen = blue.swap_store(store)
             except Exception as e:
+                # lint: ok(data-race) monotonic counter for #stats; stats()
+                # must not block on _reload_mu held across loads
                 self.reload_failures += 1
                 from ..obs import counter
                 counter("model_reload_failures_total",
@@ -168,6 +170,7 @@ class ModelReloader:
                 log.warning("model reload from %s failed; keeping the "
                             "current model: %s", target, e)
                 return {"ok": False, "error": str(e)}
+            # lint: ok(data-race) monotonic counter for #stats (see above)
             self.reloads += 1
             from ..obs import counter
             counter("model_reloads_total",
@@ -190,6 +193,8 @@ class ModelReloader:
         from concurrent.futures import ThreadPoolExecutor
 
         from .executor import PredictExecutor
+        # lint: ok(data-race) status tag for #stats/#health: GIL-atomic
+        # str assignment; stats() must not block on _reload_mu mid-warm
         self.swap_state = "warming"
         try:
             caps, keys = blue.warm_set()
@@ -223,10 +228,12 @@ class ModelReloader:
                         thread_name_prefix="bluegreen-warm") as pool:
                     for _ in pool.map(_warm_one, keys):
                         pass
+            # lint: ok(data-race) gauge for #stats (see swap_state)
             self.last_warm_ms = (time.monotonic() - t0) * 1e3
             self.swap_state = "swapping"
             green.generation = blue.generation + 1
             self._server.swap_executor(green)
+            # lint: ok(data-race) monotonic counter for #stats (see above)
             self.bluegreen_swaps += 1
             self._server.obs.counter(
                 "serve_bluegreen_swaps_total",

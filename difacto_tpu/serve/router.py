@@ -131,6 +131,7 @@ class RouterServer:
 
     # ---------------------------------------------------------- control
     def start(self) -> "RouterServer":
+        # lint: ok(data-race) monotonic stop flag; accept loop re-checks
         self._alive = True
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="router-accept", daemon=True)
@@ -163,9 +164,13 @@ class RouterServer:
                 c.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
-        for t in self._conn_threads:
+        # snapshot under _cmu: the accept loop appends under the same
+        # lock until its join above
+        with self._cmu:
+            threads = list(self._conn_threads)
+            self._conn_threads = []
+        for t in threads:
             t.join()
-        self._conn_threads.clear()
 
     # ------------------------------------------------------- accept loop
     def _accept_loop(self) -> None:

@@ -113,7 +113,10 @@ class ServeServer:
     # ---------------------------------------------------------- control
     def start(self) -> "ServeServer":
         self.batcher.start()
+        # lint: ok(data-race) monotonic stop flag; accept loop re-checks
         self._alive = True
+        # lint: ok(data-race) lifecycle handle: start() happens-before
+        # close()/drain() by operator sequencing
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="serve-accept", daemon=True)
         self._accept_thread.start()
@@ -126,6 +129,10 @@ class ServeServer:
     def wait(self, timeout: Optional[float] = None) -> None:
         """Block until close() (or the timeout elapses)."""
         self._done.wait(timeout)
+
+    def _is_closed(self) -> bool:
+        with self._mu:
+            return self._closed
 
     def close(self) -> None:
         """Stop accepting, drop connections, join every thread, unlink
@@ -152,12 +159,17 @@ class ServeServer:
             except OSError:
                 pass
         # a conn thread can reach here through #handoff -> drain ->
-        # close; never join the calling thread itself
+        # close; never join the calling thread itself. Snapshot under
+        # _mu: the accept loop appends under the same lock until its
+        # join above, and a late handler registration must not be lost
+        # to an unlocked list read
         me = threading.current_thread()
-        for t in self._conn_threads:
+        with self._mu:
+            threads = list(self._conn_threads)
+            self._conn_threads = []
+        for t in threads:
             if t is not me:
                 t.join()
-        self._conn_threads.clear()
         self.batcher.close()
 
     def drain(self, timeout_s: Optional[float] = None) -> float:
@@ -171,6 +183,8 @@ class ServeServer:
         replica out never sees admitted work dropped."""
         timeout = self.drain_timeout_s if timeout_s is None else timeout_s
         t0 = time.monotonic()
+        # lint: ok(data-race) monotonic False->True flip (GIL-atomic);
+        # handlers and #health tolerate reading either side
         self.draining = True
         self._alive = False   # accept loop exits; close() joins it
         try:
@@ -197,6 +211,8 @@ class ServeServer:
         assignments. The batcher reads ``predict_fn`` afresh per flush,
         so the in-flight batch finishes on blue and the next flush runs
         on green; blue's store/buffers drop with the last reference."""
+        # lint: ok(data-race) atomic reference swap (blue/green commit):
+        # stats/health snapshot self.executor once per call
         self.executor = new
         self.batcher.predict_fn = new.predict_scores
 
@@ -217,6 +233,9 @@ class ServeServer:
         answers ready. ``swap_state`` (idle/warming/swapping) and
         ``successor_ready`` (present once a #handoff is pending) let one
         poll loop watch both continuity paths."""
+        with self._mu:
+            successor_file = self._successor_file
+            successor_ready = self.successor_ready
         out = {
             "status": "draining" if self.draining else "ready",
             "queue_depth": self.batcher.rows_queued,
@@ -231,8 +250,8 @@ class ServeServer:
             "swap_state": (self.reloader.swap_state
                            if self.reloader is not None else "idle"),
         }
-        if self._successor_file is not None:
-            out["successor_ready"] = self.successor_ready
+        if successor_file is not None:
+            out["successor_ready"] = successor_ready
         return out
 
     # ------------------------------------------------------- connection
@@ -429,16 +448,19 @@ class ServeServer:
         end = time.monotonic() + self.handoff_wait_s
         if ready_file:
             while (not stream.isfile(ready_file)
-                   and time.monotonic() < end and not self._closed):
+                   and time.monotonic() < end
+                   and not self._is_closed()):
                 time.sleep(0.05)
-            self.successor_ready = stream.isfile(ready_file)
-            if not self.successor_ready and not self._closed:
+            ready = stream.isfile(ready_file)
+            if not ready and not self._is_closed():
                 log.warning("handoff: successor never became ready "
                             "(%s); draining anyway", ready_file)
         else:
-            self.successor_ready = True
+            ready = True
+        with self._mu:
+            self.successor_ready = ready
         log.info("handoff: draining incumbent (successor_ready=%s)",
-                 self.successor_ready)
+                 ready)
         self.drain()
 
     def _writer(self, conn: socket.socket, replies: "queue.Queue") -> None:

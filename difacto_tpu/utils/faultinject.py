@@ -134,6 +134,8 @@ def configure(spec: Optional[str] = None, seed: int = 0xD1FAC70) -> None:
     if spec is None:
         spec = os.environ.get("DIFACTO_FAULTS", "")
     _rng.seed(seed)
+    # lint: ok(data-race) armed at process/test setup before traffic;
+    # steady-state readers take the unarmed fast path
     _armed = parse(spec)
 
 

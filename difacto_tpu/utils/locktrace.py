@@ -50,7 +50,11 @@ _tls = threading.local()
 
 
 def enabled() -> bool:
-    return os.environ.get("DIFACTO_LOCKTRACE", "") not in ("", "0")
+    # DIFACTO_RACETRACE implies lock tracing: the shared-state access
+    # tracer (utils/shared.py) records each access's held-lock stack,
+    # which only exists while the factories hand out traced wrappers
+    return (os.environ.get("DIFACTO_LOCKTRACE", "") not in ("", "0")
+            or os.environ.get("DIFACTO_RACETRACE", "") not in ("", "0"))
 
 
 def _site(depth: int = 2) -> str:

@@ -37,16 +37,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from difacto_tpu.analysis import core  # noqa: E402
 from difacto_tpu.analysis.cli import DEFAULT_PATHS  # noqa: E402
 from difacto_tpu.analysis.concurrency import get_model  # noqa: E402
+from difacto_tpu.analysis.races import get_race_model  # noqa: E402
 from difacto_tpu.utils import locktrace  # noqa: E402
 
 
 def build(root=".", dynamic_path=None):
     """{'locks', 'static_edges', 'dynamic_edges', 'confirmed',
-    'dynamic_only', 'cycles'} — everything the DOT/JSON writers and the
-    tier-1 gate consume."""
+    'dynamic_only', 'guarded_by', 'cycles'} — everything the DOT/JSON
+    writers and the tier-1 gate consume."""
     root = Path(root).resolve()
     paths = [p for p in DEFAULT_PATHS if (root / p).exists()]
-    model = get_model(core.Project(root, paths))
+    project = core.Project(root, paths)
+    model = get_model(project)
+    races = get_race_model(project)
     site2lock = {f"{li.path}:{li.line}": lid
                  for lid, li in model.locks.items()}
     dynamic_edges = {}
@@ -61,6 +64,13 @@ def build(root=".", dynamic_path=None):
             dynamic_edges[(la, lb)] = dynamic_edges.get((la, lb), 0) + n
     static = set(model.edges)
     dynamic = set(dynamic_edges)
+    # invert the race pass's GuardedBy facts: lock -> fields it guards,
+    # so the lock graph shows WHAT each lock protects, not just its
+    # ordering constraints
+    guards: dict = {}
+    for fid, locks in sorted(races.guarded_by.items()):
+        for lk in locks:
+            guards.setdefault(lk, []).append(fid)
     return {
         "model": model,
         "locks": model.locks,
@@ -69,6 +79,10 @@ def build(root=".", dynamic_path=None):
         "confirmed": sorted(static & dynamic),
         "dynamic_only": sorted(dynamic - static),
         "unknown_sites": unknown_sites,
+        "guarded_by": {fid: list(locks)
+                       for fid, locks in sorted(
+                           races.guarded_by.items())},
+        "guards": guards,
         "cycles": model.cycles,
     }
 
@@ -80,6 +94,13 @@ def to_dot(graph) -> str:
     dyn_only = set(graph["dynamic_only"])
     for lid, li in sorted(graph["locks"].items()):
         label = lid.replace("::", "\\n")
+        guarded = graph["guards"].get(lid, [])
+        if guarded:
+            # what the lock protects (race-pass GuardedBy inference)
+            shown = [f.rpartition("::")[2] for f in guarded[:6]]
+            if len(guarded) > 6:
+                shown.append(f"+{len(guarded) - 6} more")
+            label += "\\nguards: " + ", ".join(shown)
         out.append(f'  "{lid}" [label="{label}\\n[{li.kind}]"];')
     for (a, b), e in sorted(graph["static_edges"].items()):
         style = ('color=black, penwidth=2.2, label="confirmed"'
@@ -100,6 +121,8 @@ def to_json(graph) -> dict:
     doc["confirmed"] = [list(e) for e in graph["confirmed"]]
     doc["dynamic_only"] = [list(e) for e in graph["dynamic_only"]]
     doc["unknown_sites"] = graph["unknown_sites"]
+    doc["guarded_by"] = graph["guarded_by"]
+    doc["guards"] = graph["guards"]
     return doc
 
 
@@ -130,6 +153,7 @@ def main(argv=None) -> int:
           f"edges, {len(graph['dynamic_edges'])} dynamic edges "
           f"({len(graph['confirmed'])} confirmed, "
           f"{len(graph['dynamic_only'])} dynamic-only), "
+          f"{len(graph['guarded_by'])} GuardedBy fields, "
           f"{len(graph['cycles'])} cycle(s)")
     for cyc in graph["cycles"]:
         print(f"lockmap: CYCLE {' -> '.join(cyc)} -> {cyc[0]}")
