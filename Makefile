@@ -15,8 +15,8 @@ DATA_IN ?= data.txt
 DATA_FORMAT ?= criteo
 DATA_OUT ?= $(basename $(DATA_IN)).rec
 
-.PHONY: test smoke ci lint lint-changed lint-baseline lockmap chaos \
-	fleet-chaos obs-report convert stream-bench
+.PHONY: test smoke ci lint lint-changed lint-baseline lockmap jitmap \
+	chaos fleet-chaos obs-report convert stream-bench
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -52,6 +52,16 @@ LOCKTRACE ?=
 lockmap:
 	$(PY) tools/lockmap.py --dot lockmap.dot --json lockmap.json \
 	  $(if $(LOCKTRACE),--dynamic $(LOCKTRACE))
+
+# merged static+dynamic jit-program map: every jit site with its
+# compile-key verdict, plus a real run's per-site compile counts and
+# fetch points (docs/static_analysis.md v4):
+#   make jitmap                            # static model only
+#   make jitmap JAXTRACE=run.jax.json      # + a DIFACTO_JAXTRACE_OUT dump
+JAXTRACE ?=
+jitmap:
+	$(PY) tools/jitmap.py --json jitmap.json \
+	  $(if $(JAXTRACE),--dynamic $(JAXTRACE))
 
 # resilience suite alone (fault injection, drain, blue/green, takeover,
 # client failover — tests/test_chaos.py and friends)

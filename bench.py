@@ -595,9 +595,11 @@ def main() -> None:
     n_bk = len(host_batches)
     u_cap = slots_l[0].shape[0]
 
-    # warmup / compile (fetch forces completion)
+    # warmup / compile (fetch forces completion; jaxtrace declares the
+    # sync so the jax-host-sync pass knows it is the harness fence)
+    from difacto_tpu.utils import jaxtrace
     state, objv, _ = step(state, batches[0], slots_l[0])
-    float(objv)
+    jaxtrace.fetch(objv, point="bench.fence")
 
     import contextlib
 
@@ -608,7 +610,7 @@ def main() -> None:
         t0 = time.perf_counter()
         for i in range(args.steps):
             state, objv, _ = step(state, batches[i % n_bk], slots_l[i % n_bk])
-        float(objv)
+        jaxtrace.fetch(objv, point="bench.fence")
         dt = time.perf_counter() - t0
 
     eps = args.steps * args.batch_size / dt

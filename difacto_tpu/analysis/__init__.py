@@ -32,6 +32,13 @@ Layout:
   thread-root discovery, the shared-state index, per-field lockset
   intersection and GuardedBy inference; the static half of the
   shared-state sentinel (utils/shared.py is the runtime half).
+- :mod:`jaxflow`     — JAX compile/transfer flow analysis
+  (``jax-recompile`` compile-key boundedness, ``jax-host-sync``
+  implicit device->host coercions on the hot path,
+  ``jax-donate-flow`` cross-edge donation safety, ``jax-dtype64``
+  fp32-pipeline drift); the static half of the jit/transfer sentinel
+  (utils/jaxtrace.py is the runtime half, tools/jitmap.py the merged
+  view).
 - :mod:`cli`         — ``python -m difacto_tpu.analysis`` /
   ``tools/lint.py`` / ``make lint`` (``--changed-only`` for the
   incremental loop; ``--format=sarif`` for code scanning).

@@ -89,7 +89,8 @@ def rule(rule_id: str, summary: str, cross: bool = False):
 
 def all_rules() -> Dict[str, Rule]:
     # import for side effect: the @rule decorators populate RULES
-    from . import concurrency, crossrules, localrules, races  # noqa: F401
+    from . import (concurrency, crossrules, jaxflow,  # noqa: F401
+                   localrules, races)
     return RULES
 
 

@@ -43,6 +43,7 @@ from ..losses import FMParams, fm_grad, fm_predict, logit_objv
 from ..losses.metrics import auc_times_n_jnp
 from ..ops.batch import DeviceBatch, bucket, pad_batch
 from ..ops.kv import expand_ranges, find_position
+from ..utils import jaxtrace
 from .base import Learner, register
 
 log = logging.getLogger("difacto_tpu")
@@ -413,14 +414,14 @@ class LBFGSLearner(Learner):
         def reg_grad(weights, reg_c):
             return reg_c * weights
 
-        self._tile_grad = jax.jit(tile_grad, donate_argnums=1)
-        self._tile_pred_auc = jax.jit(tile_pred_auc)
-        self._finish_grad = jax.jit(finish_grad)
-        self._reg_objv = jax.jit(reg_objv)
-        self._reg_grad = jax.jit(reg_grad)
-        self._axpy = jax.jit(lambda a, x, y: y + a * x)
-        self._dot = jax.jit(lambda a, b: jnp.dot(a, b))
-        self._nnz = jax.jit(lambda w: jnp.sum(w != 0))
+        self._tile_grad = jaxtrace.jit(tile_grad, donate_argnums=1)
+        self._tile_pred_auc = jaxtrace.jit(tile_pred_auc)
+        self._finish_grad = jaxtrace.jit(finish_grad)
+        self._reg_objv = jaxtrace.jit(reg_objv)
+        self._reg_grad = jaxtrace.jit(reg_grad)
+        self._axpy = jaxtrace.jit(lambda a, x, y: y + a * x)
+        self._dot = jaxtrace.jit(lambda a, b: jnp.dot(a, b))
+        self._nnz = jaxtrace.jit(lambda w: jnp.sum(w != 0))
 
     def _calc_grad(self, weights):
         """f(w), train auc, loss gradient — one pass over the LOCAL train
@@ -443,8 +444,15 @@ class LBFGSLearner(Learner):
             t0 = _time.perf_counter()
             with trace.span("lbfgs.tile_grad"):
                 o, a, grad = self._tile_grad(weights, grad, tile)
-                objv += float(o)
-                auc += float(a)
+                # ONE stacked transfer per tile for both metric scalars
+                # (the separate float(o)/float(a) pair paid two blocking
+                # RTTs; found by jax-host-sync, difacto-lint v4) — the
+                # host-float64 accumulation order is unchanged, so
+                # trajectories stay byte-identical
+                oa = jaxtrace.fetch(jnp.stack([o, a]),
+                                    point="lbfgs.tile_metrics")
+                objv += float(oa[0])
+                auc += float(oa[1])
             step_h.observe(_time.perf_counter() - t0)
         if self._num_hosts > 1:
             from ..parallel.multihost import allreduce_np
@@ -453,7 +461,8 @@ class LBFGSLearner(Learner):
             scal = allreduce_np(np.array([objv, auc], dtype=np.float64),
                                 self.monitor)
             objv, auc = float(scal[0]), float(scal[1])
-            g = allreduce_np(np.asarray(grad), self.monitor,
+            g = allreduce_np(jaxtrace.fetch(grad, point="lbfgs.grad"),
+                             self.monitor,
                              sum_dtype=np.float64)
             grad = self._put_vec(g.astype(np.float32))
         return objv, auc, self._finish_grad(grad, self._n_real)
@@ -468,7 +477,8 @@ class LBFGSLearner(Learner):
         if p.model_in:
             n = self._warm_start(p.model_in)
             log.info("warm start from %s: %d features matched", p.model_in, n)
-        r0 = float(self._reg_objv(self.weights, self.reg_c))
+        r0 = float(jaxtrace.fetch(self._reg_objv(self.weights, self.reg_c),
+                                  point="lbfgs.linesearch"))
         f0, auc, g_loss = self._calc_grad(self.weights)
         objv = r0 + f0
 
@@ -511,7 +521,10 @@ class LBFGSLearner(Learner):
             if len(s_hist) == up.m:
                 s_hist.pop(0)
             s_hist.append(direction)
-            p_gf = float(self._dot(grads, direction))
+            # declared sync: the line search needs <p,g> on host to
+            # branch — one scalar, one deliberate fetch
+            p_gf = float(jaxtrace.fetch(self._dot(grads, direction),
+                                        point="lbfgs.linesearch"))
 
             # line search (lbfgs_learner.cc:46-71)
             log.info(" - start linesearch with objv = %g, <p,g> = %g",
@@ -526,11 +539,16 @@ class LBFGSLearner(Learner):
                                           self.weights)
                 alpha = trial
                 f_new, auc, g_loss = self._calc_grad(self.weights)
-                new_objv = f_new + float(
-                    self._reg_objv(self.weights, self.reg_c))
-                pg_new = float(self._dot(g_loss, direction)) + float(
+                # the Wolfe test needs three scalars on host — ONE
+                # stacked transfer instead of three (same values, same
+                # float32->float64 conversions; jax-host-sync scrub)
+                ls = jaxtrace.fetch(jnp.stack([
+                    self._reg_objv(self.weights, self.reg_c),
+                    self._dot(g_loss, direction),
                     self._dot(self._reg_grad(self.weights, self.reg_c),
-                              direction))
+                              direction)]), point="lbfgs.linesearch")
+                new_objv = f_new + float(ls[0])
+                pg_new = float(ls[1]) + float(ls[2])
                 log.info(" - alpha = %g, objv = %g, <p,g> = %g",
                          trial, new_objv, pg_new)
                 if (new_objv <= objv + p.c1 * trial * p_gf
@@ -544,7 +562,9 @@ class LBFGSLearner(Learner):
             # kEvaluate (lbfgs_learner.cc:72-84)
             val_auc = 0.0
             for tile in self._iter_tiles("val"):
-                val_auc += float(self._tile_pred_auc(self.weights, tile))
+                val_auc += float(jaxtrace.fetch(
+                    self._tile_pred_auc(self.weights, tile),
+                    point="lbfgs.val_auc"))
             if self._num_hosts > 1 and self.nval:
                 from ..parallel.multihost import allreduce_np
                 val_auc = float(allreduce_np(
@@ -553,7 +573,8 @@ class LBFGSLearner(Learner):
                 objv=new_objv,
                 auc=auc / max(self.ntrain, 1),
                 val_auc=val_auc / self.nval if self.nval else 0.0,
-                nnz_w=float(self._nnz(self.weights)),
+                nnz_w=float(jaxtrace.fetch(self._nnz(self.weights),
+                                           point="lbfgs.nnz")),
             )
             if self.nval:
                 log.info(" - training AUC = %g, validation AUC = %g",

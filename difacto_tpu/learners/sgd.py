@@ -37,6 +37,7 @@ from ..losses import create as create_loss
 from ..ops.batch import bucket, pad_batch
 from ..store.local import SlotStore
 from ..updaters.sgd_updater import SGDUpdaterParam
+from ..utils import jaxtrace
 from ..utils.progress import Progress, ReportProg
 from .base import Learner, register
 
@@ -447,9 +448,12 @@ class SGDLearner(Learner):
         fns = self.store.fns
         _, train_step, eval_step = make_step_fns(
             fns, self.loss, train_auc=self.param.train_auc)
-        self._train_step = jax.jit(train_step, donate_argnums=0)
-        self._eval_step = jax.jit(eval_step)
-        self._apply_count = jax.jit(fns.apply_count, donate_argnums=0)
+        # every step program routes through jaxtrace.jit — identical to
+        # jax.jit unless DIFACTO_JAXTRACE=1, in which case per-site
+        # compile counts feed the jitmap/gate (analysis/jaxflow.py)
+        self._train_step = jaxtrace.jit(train_step, donate_argnums=0)
+        self._eval_step = jaxtrace.jit(eval_step)
+        self._apply_count = jaxtrace.jit(fns.apply_count, donate_argnums=0)
 
         # packed single-transfer variants (ops/batch.py pack_batch): the
         # whole batch rides in one i32 + one f32 buffer — on tunneled or
@@ -468,10 +472,10 @@ class SGDLearner(Learner):
                                            binary=binary)
             return eval_step(state, batch, slots)
 
-        self._packed_train = jax.jit(packed_train, donate_argnums=0,
-                                     static_argnums=(3, 4, 5, 6, 7))
-        self._packed_eval = jax.jit(packed_eval,
-                                    static_argnums=(3, 4, 5, 6))
+        self._packed_train = jaxtrace.jit(packed_train, donate_argnums=0,
+                                          static_argnums=(3, 4, 5, 6, 7))
+        self._packed_eval = jaxtrace.jit(packed_eval,
+                                         static_argnums=(3, 4, 5, 6))
 
         from ..ops.batch import unpack_panel
 
@@ -488,11 +492,11 @@ class SGDLearner(Learner):
                                         binary=binary)
             return eval_step(state, pb, slots)
 
-        self._packed_panel_train = jax.jit(packed_panel_train,
-                                           donate_argnums=0,
-                                           static_argnums=(3, 4, 5, 6, 7))
-        self._packed_panel_eval = jax.jit(packed_panel_eval,
-                                          static_argnums=(3, 4, 5, 6))
+        self._packed_panel_train = jaxtrace.jit(
+            packed_panel_train, donate_argnums=0,
+            static_argnums=(3, 4, 5, 6, 7))
+        self._packed_panel_eval = jaxtrace.jit(packed_panel_eval,
+                                               static_argnums=(3, 4, 5, 6))
 
         # chunked-run variant for cached replays: the backward's per-token
         # scatter becomes a dense chunk gather+reduce plus a ~U + B*F/L row
@@ -515,8 +519,8 @@ class SGDLearner(Learner):
             vals = None if binary else f32[:cells]
             return panel_chunk_tokens_flat(flat, vals, u_cap, b_cap, width)
 
-        self._panel_chunk_packed = jax.jit(panel_chunk_packed,
-                                           static_argnums=(2, 3, 4, 5))
+        self._panel_chunk_packed = jaxtrace.jit(panel_chunk_packed,
+                                                static_argnums=(2, 3, 4, 5))
 
         def packed_panel_train_chunked(state, i32, f32, ci, cl, cv, b_cap,
                                        width, u_cap, has_cnt, binary):
@@ -527,7 +531,7 @@ class SGDLearner(Learner):
             pb = pb._replace(chunk_idx=ci, chunk_lane=cl, chunk_vals=cv)
             return train_step(state, pb, slots)
 
-        self._packed_panel_train_chunked = jax.jit(
+        self._packed_panel_train_chunked = jaxtrace.jit(
             packed_panel_train_chunked, donate_argnums=0,
             static_argnums=(6, 7, 8, 9, 10))
 
@@ -549,7 +553,7 @@ class SGDLearner(Learner):
 
         # lint: ok(data-race) written once in _build_steps before any
         # warm-pool thread exists; workers only read the jitted fn
-        self._packed_panel_train_chunked2 = jax.jit(
+        self._packed_panel_train_chunked2 = jaxtrace.jit(
             packed_panel_train_chunked2, donate_argnums=0,
             static_argnums=(3, 4, 5, 6, 7))
         # statics-key -> compiled pair executable (or None while the
@@ -561,7 +565,7 @@ class SGDLearner(Learner):
         self._pair_execs: dict = {}
         # device-side zeroing of the packed f32 counts tail: replayed cache
         # entries must not re-push epoch-0 feature counts
-        self._zero_counts = jax.jit(
+        self._zero_counts = jaxtrace.jit(
             lambda f32, u_cap: f32.at[f32.shape[0] - u_cap:].set(0.0),
             static_argnums=1)
 
@@ -1262,10 +1266,21 @@ class SGDLearner(Learner):
         drain_guard = (self.monitor.collective() if self.monitor is not None
                        else contextlib.nullcontext())
         with drain_guard:
-            for nrows, objv, auc in pending:
-                prog.merge(Progress(nrows=nrows,
-                                    loss=float(np.asarray(objv)),
-                                    auc=float(np.asarray(auc))))
+            # ONE stacked transfer for the whole part's metric scalars —
+            # the per-step float(np.asarray(objv))/float(np.asarray(auc))
+            # pair this replaces paid TWO blocking device->host RTTs per
+            # step (the single-host path batched this in _merge_pending
+            # since round 5; the SPMD drain predates it and never did —
+            # found by the jax-host-sync pass, difacto-lint v4)
+            if pending:
+                vals = jaxtrace.fetch(
+                    jnp.stack([s for _, o, a in pending
+                               for s in (o, a)]),
+                    point="sgd.spmd_metrics")
+                for i, (nrows, _o, _a) in enumerate(pending):
+                    prog.merge(Progress(nrows=nrows,
+                                        loss=float(vals[2 * i]),
+                                        auc=float(vals[2 * i + 1])))
             # every host has now fetched all of this part's step results,
             # so every control payload has been consumed — reclaim the
             # coordinator's KV memory (barrier + delete own keys)
@@ -1359,7 +1374,9 @@ class SGDLearner(Learner):
         flat = jnp.stack([s for _, o, a in pending for s in (o, a)]
                          + extra)
         t0 = time.perf_counter()
-        vals = np.asarray(flat)  # the sync point where device time lands
+        # the declared sync point where device time lands (jaxtrace
+        # counts it under DIFACTO_JAXTRACE)
+        vals = jaxtrace.fetch(flat, point="sgd.metrics")
         self._add_stage("step_s", time.perf_counter() - t0)
         for i, (nrows, _, _) in enumerate(pending):
             self._rows_c.inc(nrows)
@@ -1501,22 +1518,31 @@ class SGDLearner(Learner):
                 slots = i32[off:off + u_cap]
                 fresh = jnp.where(j < nu, slots, cap + j - nu)
                 return i32.at[off:off + u_cap].set(fresh)
-            self._repad_i32 = jax.jit(repad_i32, static_argnums=(1, 2, 3),
-                                      donate_argnums=0)
+            self._repad_i32 = jaxtrace.jit(repad_i32,
+                                           static_argnums=(1, 2, 3),
+                                           donate_argnums=0)
         cap = self.store.state.capacity
         for items in cache.entries.values():
             for i, p in enumerate(items):
                 if p[0] == "panel_chunked":
                     off = p[6] * p[7]
+                    # lint: ok(jax-recompile) statics are the staged
+                    # payload's sticky pack-time caps plus the table
+                    # capacity — one recompile per GROWTH event, not
+                    # per batch (growth is log-bounded by design)
                     items[i] = (p[0], self._repad_i32(p[1], off, p[8], cap),
                                 *p[2:])
                 elif p[0] == "panel":
                     _, i32, f32, b_cap, d2, u_cap = p[:6]
+                    # lint: ok(jax-recompile) staged caps + capacity
+                    # (see the panel_chunked arm)
                     items[i] = (p[0], self._repad_i32(i32, b_cap * d2,
                                                       u_cap, cap),
                                 *p[2:])
                 elif p[0] == "coo":
                     _, i32, f32, b_cap, nnz_cap, u_cap = p[:6]
+                    # lint: ok(jax-recompile) staged caps + capacity
+                    # (see the panel_chunked arm)
                     items[i] = (p[0], self._repad_i32(i32, 2 * nnz_cap,
                                                       u_cap, cap),
                                 *p[2:])
@@ -1912,7 +1938,11 @@ class SGDLearner(Learner):
                 # consumer-side span pointing at the exact producer span
                 # that packed this batch (the id rode the ring slot
                 # header across the process boundary)
+                # step_num makes this a StepTraceAnnotation under
+                # DIFACTO_TRACE_DEVICE: the profiler's per-step device
+                # timeline aligns with the part cadence
                 with trace.span("consumer.dispatch", part=e_part,
+                                step_num=e_part,
                                 producer_span=e_span):
                     self._dispatch_item(job_type, e_item, push_cnt,
                                         want_counts, job, dim_min,
@@ -2006,6 +2036,10 @@ class SGDLearner(Learner):
             # staged chunked-run backward layout
             (_, i32, f32, ci, cl, cv, b_cap, d2, u_cap, want_counts,
              binary, nrows) = payload
+            # lint: ok(jax-recompile) payload statics are ShapeSchedule
+            # caps / bucket rungs recorded at pack or staging time —
+            # bounded by the sticky-cap contract, which provenance
+            # cannot follow through the payload tuple and device cache
             self.store.state, objv, auc = self._packed_panel_train_chunked(
                 self.store.state, i32, f32, ci, cl, cv, b_cap, d2, u_cap,
                 want_counts, binary)
@@ -2015,22 +2049,28 @@ class SGDLearner(Learner):
          nrows) = payload
         if layout == "panel":
             if is_train:
+                # lint: ok(jax-recompile) payload statics are sticky
+                # ShapeSchedule caps recorded at pack time (see above)
                 self.store.state, objv, auc = self._packed_panel_train(
                     self.store.state, i32, f32, b_cap, d2, u_cap,
                     want_counts, binary)
             else:
+                # lint: ok(jax-recompile) sticky pack-time caps (above)
                 pred, objv, auc = self._packed_panel_eval(
                     self.store.state, i32, f32, b_cap, d2, u_cap, binary)
         else:
             if is_train:
+                # lint: ok(jax-recompile) sticky pack-time caps (above)
                 self.store.state, objv, auc = self._packed_train(
                     self.store.state, i32, f32, b_cap, d2, u_cap,
                     want_counts, binary)
             else:
+                # lint: ok(jax-recompile) sticky pack-time caps (above)
                 pred, objv, auc = self._packed_eval(
                     self.store.state, i32, f32, b_cap, d2, u_cap, binary)
         if job_type == K_PREDICTION and self.param.pred_out:
-            self._save_pred(np.asarray(pred)[:nrows], label)
+            self._save_pred(jaxtrace.fetch(pred, point="sgd.pred")[:nrows],
+                            label)
         pending.append((nrows, objv, auc))
 
     def _dispatch_item(self, job_type: int, item, push_cnt: bool,
@@ -2113,7 +2153,8 @@ class SGDLearner(Learner):
         if job_type == K_PREDICTION and p.pred_out:
             # stream predictions per batch (SavePred,
             # sgd_learner.cc:231-238) — don't buffer the dataset
-            self._save_pred(np.asarray(pred)[:blk.size], blk.label)
+            self._save_pred(jaxtrace.fetch(pred, point="sgd.pred")
+                            [:blk.size], blk.label)
         pending.append((blk.size, objv, auc))
 
     def _pack_mapped(self, blk, cblk, slots_np, cnts,
@@ -2203,6 +2244,8 @@ class SGDLearner(Learner):
             # the SAME chunked step the replays use — one compiled
             # train variant per run, and every epoch takes the chunked
             # backward (docs/perf_notes.md)
+            # lint: ok(jax-recompile) statics are this batch's sticky
+            # pack-time caps — same bounded set the packed step uses
             ci, cl, cv = self._panel_chunk_packed(i32, f32, b_cap, d2,
                                                   u_cap, binary)
             chunked = True
@@ -2219,6 +2262,7 @@ class SGDLearner(Learner):
             # (epoch-0 feature-count push) is zeroed on device so a
             # replayed step never re-counts
             if wc and push_cnt:
+                # lint: ok(jax-recompile) u_cap is a sticky pack-time cap
                 f32 = self._zero_counts(f32, u_cap)
             nbytes = i32.nbytes + f32.nbytes
             # capacity recorded for the dictionary store: its staged OOB

@@ -23,6 +23,16 @@ Tracing is OFF unless ``DIFACTO_TRACE=<path>`` is set (or ``start()`` is
 called); an inactive ``span`` is a single global read plus a no-op yield.
 The event buffer is bounded (default 200k events) — overflow drops new
 events and counts them, never grows without limit.
+
+Device time (the PR 4 leftover, ROADMAP item 3): with
+``DIFACTO_TRACE_DEVICE=<logdir>`` the module also starts the JAX
+profiler and wraps every span body in a
+``jax.profiler.TraceAnnotation`` (``StepTraceAnnotation`` when the span
+carries a ``step_num`` arg), so the XLA device timeline the profiler
+writes into ``<logdir>`` carries the SAME span names as the host
+Chrome-trace file — load both in Perfetto and host stages line up with
+the device programs they dispatched. Annotations are no-ops when the
+profiler is off, so the knob composes freely with ``DIFACTO_TRACE``.
 """
 
 from __future__ import annotations
@@ -31,6 +41,7 @@ import atexit
 import contextlib
 import itertools
 import json
+import logging
 import os
 import threading
 import time
@@ -44,6 +55,7 @@ _events: List[dict] = []
 _dropped = 0
 _active = False
 _path: Optional[str] = None
+_annotate = None          # jax.profiler module once device tracing is on
 _trace_id = 0
 _span_ids = itertools.count(1)
 _tls = threading.local()  # per-thread span stack
@@ -87,6 +99,34 @@ def start(path: Optional[str] = None,
 def stop() -> None:
     global _active
     _active = False
+
+
+def start_device(logdir: str) -> bool:
+    """Start the JAX profiler into ``logdir`` and annotate every span
+    from here on (``DIFACTO_TRACE_DEVICE``). Returns False when jax or
+    its profiler is unavailable — span capture still works without."""
+    global _annotate
+    try:
+        import jax
+        jax.profiler.start_trace(logdir)
+        # lint: ok(data-race) write-once setup before any span thread
+        _annotate = jax.profiler
+        return True
+    except Exception as e:  # pragma: no cover - profiler/backend quirks
+        logging.getLogger(__name__).warning(
+            "device trace unavailable (%s); host spans continue", e)
+        return False
+
+
+def stop_device() -> None:
+    global _annotate
+    prof, _annotate = _annotate, None
+    if prof is not None:
+        try:
+            prof.stop_trace()
+        except Exception as e:  # pragma: no cover - teardown shield
+            logging.getLogger(__name__).warning(
+                "device trace stop failed: %s", e)
 
 
 def current_span_id() -> int:
@@ -146,9 +186,20 @@ def span(name: str, **args) -> Iterator[int]:
     sid = next(_span_ids)
     parent = stack[-1] if stack else 0
     stack.append(sid)
+    # device-timeline annotation (DIFACTO_TRACE_DEVICE): the profiler
+    # stamps the span name onto the XLA trace so Perfetto shows device
+    # programs under the same labels as these host events; a span
+    # carrying step_num= uses StepTraceAnnotation (JAX's step marker)
+    ann = contextlib.nullcontext()
+    if _annotate is not None:
+        ann = (_annotate.StepTraceAnnotation(
+                   name, step_num=args["step_num"])
+               if "step_num" in args
+               else _annotate.TraceAnnotation(name))
     t0 = _now_us()
     try:
-        yield sid
+        with ann:
+            yield sid
     finally:
         dur = _now_us() - t0
         stack.pop()
@@ -182,15 +233,23 @@ def save(path: Optional[str] = None) -> Optional[str]:
 
 def _maybe_start_from_env() -> None:
     path = os.environ.get("DIFACTO_TRACE", "")
-    if not path:
+    dev = os.environ.get("DIFACTO_TRACE_DEVICE", "")
+    if not path and not dev:
         return
     if os.environ.get("DIFACTO_OBS_CHILD"):
         # producer worker: collect in memory, ship via the result queue
-        # (obs/proc.py) — never write the parent's trace file
+        # (obs/proc.py) — never write the parent's trace file; the JAX
+        # profiler is the parent's too (workers own no device)
         start()
         return
-    start(path)
-    atexit.register(save)
+    start(path or None)
+    if path:
+        atexit.register(save)
+    if dev:
+        # one profiler session per process, closed at exit so the
+        # device trace flushes into <logdir> next to the span file
+        if start_device(dev):
+            atexit.register(stop_device)
 
 
 _maybe_start_from_env()

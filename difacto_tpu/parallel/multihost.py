@@ -193,8 +193,12 @@ def local_rows(arr, lo: int, hi: int) -> "np.ndarray":
     from this process's addressable shards — np.asarray would refuse on a
     multi-host sharding even though these rows live here."""
     import numpy as np
+
+    from ..utils import jaxtrace
     if getattr(arr, "is_fully_addressable", True):
-        return np.asarray(arr)[lo:hi]
+        # declared device->host sync (jaxtrace counts it): callers slice
+        # prediction rows out for the pred writer
+        return jaxtrace.fetch(arr, point="multihost.local_rows")[lo:hi]
     out = np.zeros((hi - lo,) + arr.shape[1:], dtype=arr.dtype)
     filled = np.zeros(hi - lo, dtype=bool)
     for sh in arr.addressable_shards:

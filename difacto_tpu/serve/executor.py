@@ -38,6 +38,7 @@ from ..losses import LossSpec, create as create_loss
 from ..ops.batch import pack_batch, unpack_batch
 from ..step import make_predict_fn
 from ..store.local import SlotStore, pad_slots_oob
+from ..utils import jaxtrace
 from ..utils.locktrace import mutex
 
 
@@ -68,7 +69,11 @@ class PredictExecutor:
                                            binary=binary)
             return predict_step(state, batch, slots)
 
-        self._packed = jax.jit(packed_predict, static_argnums=(3, 4, 5, 6))
+        # jaxtrace.jit: identical to jax.jit when DIFACTO_JAXTRACE is
+        # off; traced, this is THE serve jit site the tier-1 gate holds
+        # to "zero steady-state recompiles" (analysis/jaxflow.py)
+        self._packed = jaxtrace.jit(packed_predict,
+                                    static_argnums=(3, 4, 5, 6))
         self._shapes = ShapeSchedule()
         self._mu = mutex()
         self._buckets: dict = {}   # statics key -> dispatch count
@@ -127,6 +132,10 @@ class PredictExecutor:
         padded = pad_slots_oob(np.zeros(1, dtype=np.int32), u_cap,
                                store.state.capacity)
         i32, f32, _ = pack_batch(blk, 1, padded, b_cap, nnz_cap, u_cap)
+        # lint: ok(jax-recompile) warm replay iterates PREVIOUSLY
+        # RECORDED bucket keys (warm_set) — a subset of the compiled
+        # set by construction, so no key here is ever a fresh compile
+        # on the predecessor's model and at most one on the successor's
         pred, _, _ = self._packed(store.state, jnp.asarray(i32),
                                   jnp.asarray(f32), b_cap, nnz_cap, u_cap,
                                   binary)
@@ -203,10 +212,17 @@ class PredictExecutor:
         with self._mu:
             self._buckets[key] = self._buckets.get(key, 0) + 1
             self._dispatches += 1
+        # lint: ok(jax-recompile) `binary` is a bool from pack_batch —
+        # two compile keys by construction (the caps above are proven)
         pred, objv, auc = self._packed(store.state, jnp.asarray(i32),
                                        jnp.asarray(f32), b_cap, nnz_cap,
                                        u_cap, binary)
-        return np.asarray(pred)[:blk.size], objv, auc
+        # the ONE declared device->host sync of the serve dispatch loop:
+        # scores must reach the response formatter; objv/auc stay on
+        # device for deferred fetch. DIFACTO_JAXTRACE counts this site,
+        # and the tier-1 gate asserts it is the only one.
+        return jaxtrace.fetch(pred, point="serve.scores")[:blk.size], \
+            objv, auc
 
     def predict_scores(self, blk: RowBlock) -> np.ndarray:
         """Scores only — the micro-batcher's entry."""

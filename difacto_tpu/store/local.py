@@ -316,8 +316,8 @@ class SlotStore:
         other pending metrics (a sync fetch costs a full RTT on tunneled
         chips, docs/perf_notes.md)."""
         if not hasattr(self, "_eval_jit"):
-            import jax
-            self._eval_jit = jax.jit(self.fns.evaluate)
+            from ..utils import jaxtrace
+            self._eval_jit = jaxtrace.jit(self.fns.evaluate)
         return self._eval_jit(self.state)
 
     # ------------------------------------------------------------- ckpt

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Merged static + dynamic jit-program map — `make jitmap` runs this.
+
+The static half is the JAX flow model difacto-lint builds
+(difacto_tpu/analysis/jaxflow.py): every jit program in the tree, its
+static/donate argnums, its call sites, and the compile-key verdict —
+whether every static is provably drawn from a bounded set (the sticky
+shape caps / bucket rungs / config constants) or rides a reasoned
+``# lint: ok(jax-recompile)`` suppression. The dynamic half is an
+optional jaxtrace dump (DIFACTO_JAXTRACE=1 +
+DIFACTO_JAXTRACE_OUT=<path> or jaxtrace.dump()): the per-site
+call/compile counts and device->host fetch points a real run recorded.
+Both halves key sites by the same ``relpath:lineno`` identity, so
+merging answers:
+
+- which jit programs a real run exercised, with how many compiles per
+  site (a steady-state run should show compiles << calls everywhere);
+- whether any observed jit site is MISSING from the static model, or
+  dynamically compiled at a site the model could not declare
+  warm-bounded (``unknown_sites`` / ``unwarm_sites``);
+- whether any observed device->host transfer happened at a fetch site
+  the static model does not list as declared (``unknown_fetches``).
+
+Usage:
+  python tools/jitmap.py [--dynamic trace.json] [--json jitmap.json]
+                         [--check]
+
+``--check`` exits 1 on any unknown/unwarm dynamic site or undeclared
+fetch (CI-able); the default is informational (exit 0).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from difacto_tpu.analysis import core  # noqa: E402
+from difacto_tpu.analysis.cli import DEFAULT_PATHS  # noqa: E402
+from difacto_tpu.analysis.jaxflow import get_jax_model  # noqa: E402
+from difacto_tpu.utils import jaxtrace  # noqa: E402
+
+
+def build(root=".", dynamic_path=None):
+    """{'sites', 'fetch_sites', 'hot_roots', 'dynamic_sites',
+    'dynamic_fetches', 'unknown_sites', 'unwarm_sites',
+    'unknown_fetches'} — everything the writers, the --check gate and
+    the tier-1 test consume."""
+    root = Path(root).resolve()
+    paths = [p for p in DEFAULT_PATHS if (root / p).exists()]
+    project = core.Project(root, paths)
+    model = get_jax_model(project)
+    doc = model.to_json()
+    warm = model.known_warm()
+    declared = model.declared_fetches()
+    out = {
+        "sites": doc["sites"],
+        "fetch_sites": doc["fetch_sites"],
+        "hot_roots": doc["hot_roots"],
+        "dynamic_sites": {},
+        "dynamic_fetches": {},
+        "unknown_sites": [],
+        "unwarm_sites": [],
+        "unknown_fetches": [],
+    }
+    if dynamic_path:
+        data = jaxtrace.load(dynamic_path)
+        out["dynamic_sites"] = data["sites"]
+        out["dynamic_fetches"] = data["fetches"]
+        for site in sorted(data["sites"]):
+            if site not in model.sites:
+                out["unknown_sites"].append(site)
+            elif site not in warm:
+                out["unwarm_sites"].append(site)
+        for site in sorted(data["fetches"]):
+            if site not in declared:
+                out["unknown_fetches"].append(site)
+    return out
+
+
+def to_text(graph) -> str:
+    lines = []
+    dyn = graph["dynamic_sites"]
+    for sid, rec in sorted(graph["sites"].items()):
+        mark = "WARM " if rec["warm_bounded"] else "loose"
+        d = dyn.get(sid)
+        run = (f"  [{d['compiles']} compiles / {d['calls']} calls]"
+               if d else "")
+        lines.append(f"{mark} {sid}  jit({rec['target']}) "
+                     f"statics={rec['static_argnums']} "
+                     f"donate={rec['donate_argnums']}{run}")
+        for u in rec["unbounded"]:
+            lines.append(f"      suppressed/loose static {u['static']} "
+                         f"at {u['call']}: {u['reason'][:90]}")
+    lines.append(f"declared fetch points: "
+                 f"{len(graph['fetch_sites'])}")
+    for site in graph["fetch_sites"]:
+        d = graph["dynamic_fetches"].get(site)
+        run = f"  [{d['count']}x {d['point']}]" if d else ""
+        lines.append(f"  fetch {site}{run}")
+    for key in ("unknown_sites", "unwarm_sites", "unknown_fetches"):
+        for site in graph[key]:
+            lines.append(f"{key.upper().replace('_', '-')}: {site}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merged static+dynamic jit-program map "
+                    "(docs/static_analysis.md v4)")
+    ap.add_argument("--root", default=".")
+    ap.add_argument("--dynamic", default=None,
+                    help="jaxtrace dump (DIFACTO_JAXTRACE_OUT) to merge")
+    ap.add_argument("--json", default=None, help="write JSON here")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on unknown/unwarm dynamic jit sites "
+                         "or undeclared fetch points")
+    args = ap.parse_args(argv)
+    graph = build(args.root, args.dynamic)
+    if args.json:
+        Path(args.json).write_text(
+            json.dumps(graph, indent=1, sort_keys=True) + "\n",
+            encoding="utf-8")
+        print(f"jitmap: wrote {args.json}")
+    print(to_text(graph))
+    if args.check and (graph["unknown_sites"] or graph["unwarm_sites"]
+                       or graph["unknown_fetches"]):
+        print("jitmap: CHECK FAILED — dynamic site/fetch outside the "
+              "static model", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
