@@ -16,7 +16,7 @@ DATA_FORMAT ?= criteo
 DATA_OUT ?= $(basename $(DATA_IN)).rec
 
 .PHONY: test smoke ci lint lint-changed lint-baseline lockmap jitmap \
-	chaos fleet-chaos obs-report convert stream-bench
+	chaos fleet-chaos obs-report convert stream-bench multichip-bench
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -101,3 +101,9 @@ convert:
 # the per-stage breakdown and the delta vs the newest BENCH_r*.json)
 stream-bench:
 	$(PY) bench.py --e2e
+
+# fs-sharded capacity-scaling legs alone: table = base*fs rows per fs
+# rung in {1,2,4,8}, ex/s + per-device bytes per leg (the MULTICHIP
+# metric; docs/perf_notes.md "Mesh-sharded parameter table")
+multichip-bench:
+	$(PY) bench.py --multichip

@@ -489,6 +489,24 @@ def run_serve_bench(args) -> dict:
     }
 
 
+def run_multichip(args) -> dict:
+    """multichip.* section: the capacity-scaling trajectory of the
+    fs-sharded slot table (difacto_tpu/parallel/capacity.py) — for each
+    fs rung the table is ``--capacity * fs`` rows over fs devices, so
+    the legs show max trainable hash_capacity growing with the mesh at
+    ~constant per-device bytes while ex/s reports the collective cost.
+    The driver's MULTICHIP_r*.json gets the same metric from
+    __graft_entry__.dryrun_multichip (small shapes); this leg is the
+    full-size version for by-hand runs on the 8-chip box."""
+    from difacto_tpu.parallel.capacity import capacity_scaling_report
+
+    return capacity_scaling_report(
+        base_capacity=args.multichip_capacity,
+        V_dim=args.vdim, batch=args.batch_size,
+        nnz_per_row=args.nnz_per_row, steps=args.steps,
+        v_dtype=args.vdtype)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=65536)
@@ -511,6 +529,14 @@ def main() -> None:
     mode.add_argument("--serve", action="store_true",
                       help="online-serving latency/throughput ONLY: "
                            "in-process server + open-loop Poisson loadgen")
+    mode.add_argument("--multichip", action="store_true",
+                      help="fs-sharded table capacity-scaling ONLY: "
+                           "table of --multichip-capacity * fs rows per "
+                           "fs rung in {1,2,4,8}, ex/s + per-device "
+                           "bytes per leg")
+    ap.add_argument("--multichip-capacity", type=int, default=1 << 20,
+                    help="per-fs-rung base hash_capacity of the "
+                         "--multichip sweep (table = base * fs rows)")
     ap.add_argument("--serve-qps", type=float, default=500.0,
                     help="target offered rate for the serve bench")
     ap.add_argument("--serve-seconds", type=float, default=5.0)
@@ -552,6 +578,9 @@ def main() -> None:
         return
     if args.serve:
         print(json.dumps({"serve": run_serve_bench(args)}))
+        return
+    if args.multichip:
+        print(json.dumps({"multichip": run_multichip(args)}))
         return
 
     import jax

@@ -293,6 +293,10 @@ class SGDLearnerParam(Param):
     # launch.py's -s/-n server/worker counts.
     mesh_fs: int = 1
     mesh_dp: int = 1
+    # instantiate the mesh even at 1x1 (normally 1x1 = no mesh): the
+    # degenerate-mesh parity leg — the sharded program path must be
+    # byte-identical to the flat path at fs=1 (tests/test_fs_sharding.py)
+    mesh_force: bool = False
     # multi-host SPMD caps: every host must jit the same batch shapes, so
     # the per-host nnz / distinct-feature buckets are pinned up front
     # (0 = auto: bucket(batch_size * 64)). Single-host runs ignore these
@@ -331,7 +335,8 @@ class SGDLearner(Learner):
         if uparam.V_dim != self.V_dim:
             uparam = dataclasses.replace(uparam, V_dim=self.V_dim)
         self.mesh = None
-        if self.param.mesh_fs * self.param.mesh_dp > 1:
+        if self.param.mesh_fs * self.param.mesh_dp > 1 \
+                or self.param.mesh_force:
             from ..parallel import make_mesh
             self.mesh = make_mesh(dp=self.param.mesh_dp,
                                   fs=self.param.mesh_fs)
@@ -380,6 +385,10 @@ class SGDLearner(Learner):
             "host-side dispatch+wait time of one fused device step")
         self._rows_c = self.obs.counter(
             "train_rows_total", "examples consumed by dispatched steps")
+        self._gather_c = self.obs.counter(
+            "store_gather_bytes_total",
+            "slot-table row bytes gathered+scattered per dispatched "
+            "device program").labels(path="train")
         self._last_producer_mode = "thread"
         self._flusher = None
         self._shapes = _ShapeSchedule()
@@ -444,16 +453,30 @@ class SGDLearner(Learner):
 
     def _build_steps(self) -> None:
         from ..ops.batch import unpack_batch
-        from ..step import make_step_fns
+        from ..step import make_step_fns, state_constrainer
         fns = self.store.fns
+        # mesh runs pin the table's fs key-range layout INSIDE every
+        # program that returns state (step.state_constrainer): the
+        # donated update stays in place across shards instead of
+        # round-tripping through whatever layout GSPMD inference picks
+        state_shardings = None
+        if self.mesh is not None:
+            from ..parallel import sharding_tree, state_sharding
+            state_shardings = sharding_tree(self.store.state,
+                                            state_sharding(self.mesh))
+        constrain = state_constrainer(state_shardings)
         _, train_step, eval_step = make_step_fns(
-            fns, self.loss, train_auc=self.param.train_auc)
+            fns, self.loss, train_auc=self.param.train_auc,
+            state_shardings=state_shardings)
         # every step program routes through jaxtrace.jit — identical to
         # jax.jit unless DIFACTO_JAXTRACE=1, in which case per-site
         # compile counts feed the jitmap/gate (analysis/jaxflow.py)
         self._train_step = jaxtrace.jit(train_step, donate_argnums=0)
         self._eval_step = jaxtrace.jit(eval_step)
-        self._apply_count = jaxtrace.jit(fns.apply_count, donate_argnums=0)
+        self._apply_count = jaxtrace.jit(
+            lambda state, slots, counts: constrain(
+                fns.apply_count(state, slots, counts)),
+            donate_argnums=0)
 
         # packed single-transfer variants (ops/batch.py pack_batch): the
         # whole batch rides in one i32 + one f32 buffer — on tunneled or
@@ -699,6 +722,10 @@ class SGDLearner(Learner):
         if p.model_out:
             log.info("saving final model...")
             self.store.save(self._model_name(p.model_out, -1), p.has_aux)
+        if self.store.fs_count > 1:
+            # per-shard occupancy gauges (docs/observability.md): one
+            # full-table host read at run end, never per step
+            self.store.publish_shard_stats()
         self.stop()
 
     def stop(self) -> None:
@@ -2009,6 +2036,16 @@ class SGDLearner(Learner):
         + the train_step_seconds histogram."""
         from ..step import fire_step_fault
         fire_step_fault()
+        # table row traffic of this dispatch: u_cap fused rows pulled,
+        # and pushed again when training (updaters.gather_bytes; the
+        # serve path counts its own under path="serve")
+        from ..updaters.sgd_updater import gather_bytes
+        u_cap = (payload[2].shape[0] if payload[0] == "devbatch"
+                 else payload[8] if payload[0] == "panel_chunked"
+                 else payload[5])
+        per_dir = gather_bytes(self.store.param, self.store.state.capacity,
+                               u_cap)
+        self._gather_c.inc(per_dir * (2 if job_type == K_TRAINING else 1))
         t0 = time.perf_counter()
         try:
             self._dispatch_packed_inner(job_type, payload, pending, label)
@@ -2140,6 +2177,10 @@ class SGDLearner(Learner):
             c[:len(cnts)] = cnts
             self.store.state = self._apply_count(
                 self.store.state, slots, jnp.asarray(c))
+        from ..updaters.sgd_updater import gather_bytes
+        per_dir = gather_bytes(self.store.param,
+                               self.store.state.capacity, u_cap)
+        self._gather_c.inc(per_dir * (2 if job_type == K_TRAINING else 1))
         if job_type == K_TRAINING:
             self.store.state, objv, auc = self._train_step(
                 self.store.state, dev, slots)

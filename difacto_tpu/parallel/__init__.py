@@ -1,9 +1,11 @@
 """Parallelism: device mesh, shardings, and the ICI parameter-server layout."""
 
-from .mesh import (DP_AXIS, FS_AXIS, batch_sharding, make_mesh, put_dp_local,
-                   put_global, replicated, shard_pytree, sharding_tree,
-                   state_sharding)
+from .mesh import (DP_AXIS, FS_AXIS, batch_sharding, fs_shard_bounds,
+                   fs_size, make_mesh, put_dp_local, put_global, replicated,
+                   shard_pytree, sharding_tree, state_sharding,
+                   validate_fs_capacity)
 
 __all__ = ["DP_AXIS", "FS_AXIS", "make_mesh", "state_sharding",
            "batch_sharding", "replicated", "shard_pytree", "sharding_tree",
-           "put_global", "put_dp_local"]
+           "put_global", "put_dp_local", "fs_size", "fs_shard_bounds",
+           "validate_fs_capacity"]

@@ -68,6 +68,36 @@ def make_mesh(dp: int = 1, fs: int = 1,
     return Mesh(arr, (DP_AXIS, FS_AXIS))
 
 
+def fs_size(mesh: Optional[Mesh]) -> int:
+    """Feature-shard degree of a mesh (1 for no mesh): the number of
+    contiguous key-range shards the slot table splits into."""
+    return 1 if mesh is None else int(mesh.shape[FS_AXIS])
+
+
+def validate_fs_capacity(capacity: int, fs: int) -> None:
+    """Every sharded dim must divide the fs axis evenly (jax rejects
+    uneven NamedShardings): power-of-two capacities always do, but
+    ``hash_capacity`` is user-chosen — fail at construction, not at the
+    first device_put deep inside a train step."""
+    if fs > 1 and capacity % fs:
+        raise ValueError(
+            f"table capacity {capacity} is not divisible by mesh fs={fs}: "
+            "the slot table shards its capacity axis in contiguous "
+            "key ranges, one per fs device — pick hash_capacity (or "
+            "init_capacity) as a multiple of fs")
+
+
+def fs_shard_bounds(capacity: int, fs: int):
+    """[(lo, hi)] row ranges per fs shard — the contiguous key ranges of
+    the table's capacity axis, the TPU analog of ps-lite's per-server
+    key ranges (kvstore_dist.h:90-118). Shard i owns slots
+    [i*capacity/fs, (i+1)*capacity/fs); per-shard checkpoints
+    (store/local.py save) slice and restore exactly these rows."""
+    validate_fs_capacity(capacity, fs)
+    rows = capacity // fs
+    return [(i * rows, (i + 1) * rows) for i in range(fs)]
+
+
 def state_sharding(mesh: Mesh):
     """NamedSharding pytree spec for SGDState: capacity axis over fs.
 

@@ -103,6 +103,11 @@ def run_serve(kwargs: KWArgs) -> KWArgs:
     param, remain = ServeParam.init_allow_unknown(kwargs)
     if not param.model_in:
         raise ValueError("please set model_in")
+    # the store-construction kwargs (updater overrides + serve_mesh_fs)
+    # also go to the reloader: a hot reload must rebuild the SAME store
+    # geometry — in particular the same fs-sharded mesh — or the swap
+    # would silently de-shard the table
+    store_kwargs = list(remain)
     store, meta, remain = open_serving_store(param.model_in, remain)
     server = ServeServer(
         store, host=param.serve_host, port=param.serve_port,
@@ -121,7 +126,7 @@ def run_serve(kwargs: KWArgs) -> KWArgs:
     # failing (serve/reload.py)
     reloader = ModelReloader(server.executor, param.model_in,
                              poll_s=param.serve_reload_poll_s,
-                             server=server)
+                             kwargs=store_kwargs, server=server)
     server.reloader = reloader
     # signal.signal only works on the main thread; tests drive run_serve
     # from worker threads and manage shutdown themselves

@@ -63,6 +63,13 @@ class PredictExecutor:
         self.loss = loss if loss is not None \
             else create_loss("fm", store.param.V_dim)
         predict_step = make_predict_fn(store.fns, self.loss)
+        # serve-path gather traffic: u_cap fused rows in+out per dispatch
+        # (updaters.gather_bytes; docs/observability.md catalog)
+        from ..obs import counter
+        self._gather_c = counter(
+            "store_gather_bytes_total",
+            "slot-table row bytes gathered+scattered per dispatched "
+            "device program").labels(path="serve")
 
         def packed_predict(state, i32, f32, b_cap, nnz_cap, u_cap, binary):
             batch, slots, _ = unpack_batch(i32, f32, b_cap, nnz_cap, u_cap,
@@ -74,6 +81,15 @@ class PredictExecutor:
         # to "zero steady-state recompiles" (analysis/jaxflow.py)
         self._packed = jaxtrace.jit(packed_predict,
                                     static_argnums=(3, 4, 5, 6))
+        # fs-sharded stores (serve_mesh_fs > 1): batch buffers ride
+        # replicated over the mesh so the jitted gather pulls key-range
+        # rows across shards; flat stores keep the plain asarray put
+        if store.mesh is not None:
+            from ..parallel import put_global, replicated
+            repl = replicated(store.mesh)
+            self._put = lambda a: put_global(np.asarray(a), repl)
+        else:
+            self._put = jnp.asarray
         self._shapes = ShapeSchedule()
         self._mu = mutex()
         self._buckets: dict = {}   # statics key -> dispatch count
@@ -136,8 +152,8 @@ class PredictExecutor:
         # RECORDED bucket keys (warm_set) — a subset of the compiled
         # set by construction, so no key here is ever a fresh compile
         # on the predecessor's model and at most one on the successor's
-        pred, _, _ = self._packed(store.state, jnp.asarray(i32),
-                                  jnp.asarray(f32), b_cap, nnz_cap, u_cap,
+        pred, _, _ = self._packed(store.state, self._put(i32),
+                                  self._put(f32), b_cap, nnz_cap, u_cap,
                                   binary)
         jax.block_until_ready(pred)
         with self._mu:
@@ -169,6 +185,15 @@ class PredictExecutor:
                 "swap keeps the compiled programs, so a geometry change "
                 "must go through the blue/green executor swap "
                 "(serve/reload.py, requires a server-attached reloader)")
+        if store.fs_count != old.fs_count:
+            # the compiled predict programs bake the table's sharding
+            # layout; a different fs degree is a geometry change too
+            raise ValueError(
+                f"hot-reload geometry mismatch: serving an "
+                f"fs={old.fs_count}-sharded table, new store is "
+                f"fs={store.fs_count}; pass the same serve_mesh_fs on "
+                "the reload path (run_serve threads it automatically) "
+                "or go through the blue/green executor swap")
         with self._mu:
             # lint: ok(data-race) atomic reference swap (hot-reload commit
             # point): predict/warm snapshot self.store once per call
@@ -212,10 +237,13 @@ class PredictExecutor:
         with self._mu:
             self._buckets[key] = self._buckets.get(key, 0) + 1
             self._dispatches += 1
+        from ..updaters.sgd_updater import gather_bytes
+        self._gather_c.inc(gather_bytes(store.param, store.state.capacity,
+                                        u_cap))
         # lint: ok(jax-recompile) `binary` is a bool from pack_batch —
         # two compile keys by construction (the caps above are proven)
-        pred, objv, auc = self._packed(store.state, jnp.asarray(i32),
-                                       jnp.asarray(f32), b_cap, nnz_cap,
+        pred, objv, auc = self._packed(store.state, self._put(i32),
+                                       self._put(f32), b_cap, nnz_cap,
                                        u_cap, binary)
         # the ONE declared device->host sync of the serve dispatch loop:
         # scores must reach the response formatter; objv/auc stay on

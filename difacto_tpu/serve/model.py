@@ -17,12 +17,27 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
-from ..config import KWArgs
+from ..config import KWArgs, Param
 from ..utils import stream
 
 log = logging.getLogger("difacto_tpu")
+
+
+@dataclass
+class ServingShardParam(Param):
+    """Mesh knobs of the serving-store open path (docs/serving.md).
+
+    ``serve_mesh_fs > 1`` places the read-only table fs-sharded over a
+    (1, serve_mesh_fs) device mesh (parallel/mesh.py) — the serving
+    analog of training's ``mesh_fs``: each device holds one contiguous
+    key-range shard, so a model bigger than one device's HBM serves
+    from N devices. Power of two, must divide ``hash_capacity``, and
+    must be threaded through hot-reloads (run_serve passes the same
+    kwargs to the ModelReloader so a reload rebuilds the same mesh)."""
+    serve_mesh_fs: int = field(default=1, metadata=dict(lo=1))
 
 
 def store_geometry(param) -> Tuple[int, int]:
@@ -70,6 +85,9 @@ def model_meta(uri: str) -> dict:
                               if "hash_capacity" in files else 0),
             "V_dim": int(z["V_dim"]) if "V_dim" in files else 0,
             "save_aux": bool(z["save_aux"]) if "save_aux" in files else False,
+            # per-key-range shard count of the save (store/local.py
+            # _save_sharded); 1 = single-file table
+            "fs_count": int(z["fs_count"]) if "fs_count" in files else 1,
         }
 
 
@@ -133,14 +151,25 @@ def _open_verified(path: str, kwargs: KWArgs
             f"learner={meta['learner']!r}; the serving executor loads sgd "
             "SlotStore checkpoints only — re-train with learner=sgd to "
             "serve this data")
-    uparam, remain = SGDUpdaterParam.init_allow_unknown(list(kwargs))
+    sparam, kwargs = ServingShardParam.init_allow_unknown(list(kwargs))
+    uparam, remain = SGDUpdaterParam.init_allow_unknown(kwargs)
     uparam = dataclasses.replace(uparam, V_dim=meta["V_dim"],
                                  hash_capacity=meta["hash_capacity"])
-    store = SlotStore(uparam, read_only=True)
+    mesh = None
+    if sparam.serve_mesh_fs > 1:
+        # fs-sharded serving: the same (dp, fs) mesh machinery as
+        # training, dp pinned to 1 — the read-only table splits into
+        # contiguous key-range shards and the predict programs pull rows
+        # across shards with XLA collectives (any checkpoint layout
+        # loads into any serve_mesh_fs, the shard files are just IO)
+        from ..parallel import make_mesh
+        mesh = make_mesh(dp=1, fs=sparam.serve_mesh_fs)
+    store = SlotStore(uparam, read_only=True, mesh=mesh)
     # single-pass verified load: members hash while they stream in
     # (manifest.VerifiedNpz) — no separate verify read
     n = store.load(meta["path"])
-    log.info("serving store: %s (%s, V_dim=%d, %d non-empty entries, "
-             "weights-only)", meta["path"],
-             "hashed" if meta["hashed"] else "dictionary", meta["V_dim"], n)
+    log.info("serving store: %s (%s, V_dim=%d, fs=%d, %d non-empty "
+             "entries, weights-only)", meta["path"],
+             "hashed" if meta["hashed"] else "dictionary", meta["V_dim"],
+             store.fs_count, n)
     return store, meta, remain

@@ -23,20 +23,44 @@ sgd_learner.cc:92-110).
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from .losses import FMParams, LossSpec
 from .losses.metrics import auc_times_n_binned_jnp, auc_times_n_jnp
 
 
-def make_step_fns(fns, loss: LossSpec, train_auc: str = "binned") -> Tuple:
+def state_constrainer(state_shardings):
+    """Pin a returned SGDState to its fs-sharded layout INSIDE the jitted
+    program (``state_shardings`` is the NamedSharding pytree from
+    parallel.sharding_tree(state, state_sharding(mesh))).
+
+    This is how the mesh layout is threaded through the fused programs
+    rather than left to GSPMD inference: the donated state argument
+    arrives fs-sharded and the constrained output is guaranteed the SAME
+    key-range layout, so XLA's buffer donation keeps the in-place table
+    update across shards — the table never round-trips through a
+    replicated or re-partitioned intermediate, whatever the surrounding
+    batch shardings make the propagation pass prefer. ``None`` (no mesh)
+    is the identity."""
+    if state_shardings is None:
+        return lambda state: state
+    return lambda state: jax.lax.with_sharding_constraint(
+        state, state_shardings)
+
+
+def make_step_fns(fns, loss: LossSpec, train_auc: str = "binned",
+                  state_shardings=None) -> Tuple:
     """(forward, train_step, eval_step) over (state, batch, slots).
 
     ``fns`` is the updater namespace from updaters.sgd_updater.make_fns;
     all three returned callables are pure and jit-ready.
+    ``state_shardings`` (mesh runs) pins the returned state to the
+    table's fs key-range layout — see :func:`state_constrainer`.
     """
+    constrain = state_constrainer(state_shardings)
 
     def pull(state, batch, slots):
         w, V, vmask = fns.get_rows(state, slots)
@@ -64,7 +88,7 @@ def make_step_fns(fns, loss: LossSpec, train_auc: str = "binned") -> Tuple:
             auc = jnp.float32(0.0)
         gw, gV = loss.calc_grad(params, batch, pred, xv)
         state = fns.apply_grad(state, slots, gw, gV, slot_vmask)
-        return state, objv, auc
+        return constrain(state), objv, auc
 
     def eval_step(state, batch, slots):
         _, pred, objv, auc = forward(state, batch, slots)

@@ -39,6 +39,14 @@ K_WEIGHT = 2
 K_GRADIENT = 3
 
 
+def fs_shard_path(path: str, shard: int, count: int) -> str:
+    """Per-shard checkpoint member name: ``<path>_fs-<i>-of-<n>``. The
+    decoration is stripped by manifest.family_prefix (like ``_iter-k`` /
+    ``_part-r``), so shard members prune and generation-walk with their
+    family; only the undecorated stub is a load entry point."""
+    return f"{path}_fs-{shard}-of-{count}"
+
+
 def pad_slots_oob(slots: np.ndarray, cap: int, capacity: int) -> np.ndarray:
     """int32[cap]: sorted unique ``slots`` followed by ascending
     out-of-bounds padding (capacity, capacity+1, ...)."""
@@ -119,7 +127,21 @@ class SlotStore:
         if initial_capacity is None:
             initial_capacity = param.init_capacity
         cap = param.hash_capacity if self.hashed else initial_capacity
+        if self.fs_count > 1:
+            # uneven NamedShardings are a jax error at device_put time —
+            # fail at construction with the knob to fix (doubling growth
+            # preserves divisibility, so checking the initial capacity
+            # covers the dictionary store's whole life)
+            from ..parallel import validate_fs_capacity
+            validate_fs_capacity(cap, self.fs_count)
         self.state: SGDState = self._place(init_state(param, cap))
+
+    @property
+    def fs_count(self) -> int:
+        """Feature-shard degree: how many contiguous key-range shards
+        the table's capacity axis splits into (1 = single device)."""
+        from ..parallel import fs_size
+        return fs_size(self.mesh)
 
     def _place(self, state: SGDState) -> SGDState:
         if self.mesh is None:
@@ -383,7 +405,8 @@ class SlotStore:
                         v_live=jnp.zeros(0, dtype=bool))
 
     def save(self, path: str, save_aux: bool = False,
-             epoch: Optional[int] = None, keep: int = 0) -> int:
+             epoch: Optional[int] = None, keep: int = 0,
+             shards: Optional[int] = None) -> int:
         """Checkpoint non-empty entries, sorted by key. Hashed mode has no
         id dictionary — the full dense table is saved instead.
 
@@ -393,9 +416,24 @@ class SlotStore:
         the commit marker a torn write can't fake. ``keep > 0`` retires
         interval (``_iter-k``) checkpoints of this family older than the
         newest ``keep`` epochs; the final undecorated model is never
-        pruned."""
+        pruned.
+
+        ``shards`` (default: the mesh's fs degree) splits a HASHED
+        table's dense arrays into per-key-range member files
+        ``<path>_fs-<i>-of-<n>`` — one per fs shard, each with its own
+        verifying manifest — plus an array-free stub at ``<path>``
+        written LAST as the generation's commit marker. An fs-sharded
+        table bigger than one device's HBM round-trips through these
+        without the artifact ever pretending to be a one-device array,
+        and a corrupt shard fails typed so loaders walk back a
+        generation (load below, serve/model.py)."""
         saved = ("w", "cnt", "v_live", "V") + (
             ("z", "sqrt_g", "Vg") if save_aux else ())
+        if shards is None:
+            shards = self.fs_count if self.hashed else 1
+        if self.hashed and shards > 1:
+            return self._save_sharded(path, saved, save_aux, epoch, keep,
+                                      shards)
         if self.hashed:
             st = self._state_np(self.state, keys=saved)
             arrays = dict(hash_capacity=np.array(self.param.hash_capacity),
@@ -435,6 +473,51 @@ class SlotStore:
         # for the same reason, docs/perf_notes.md streamed regime)
         stream.save_npz(path, compress=False, manifest=man,
                         fault_point="ckpt.write", **arrays)
+        if keep > 0:
+            import re
+            m = re.search(r"_part-(\d+)", path)
+            mft.prune_checkpoints(path, keep,
+                                  rank=int(m.group(1)) if m else None)
+        return n
+
+    def _save_sharded(self, path: str, saved, save_aux: bool,
+                      epoch: Optional[int], keep: int, shards: int) -> int:
+        """Per-key-range checkpoint of the hashed table (see save):
+        shard files carry rows [lo, hi) of every column plus their own
+        geometry stamp; the stub closes the generation."""
+        from ..parallel import fs_shard_bounds
+        cap = self.param.hash_capacity
+        bounds = fs_shard_bounds(cap, shards)
+        st = self._state_np(self.state, keys=saved)
+        gen = mft.next_generation(path)
+        n = int((st["w"] != 0).sum())
+        geom = dict(hash_capacity=np.array(cap),
+                    V_dim=np.array(self.param.V_dim),
+                    save_aux=np.array(save_aux),
+                    learner=np.array("sgd"),
+                    fs_count=np.array(shards))
+        for i, (lo, hi) in enumerate(bounds):
+            man = {"learner": "sgd",
+                   "rows": int((st["w"][lo:hi] != 0).sum()),
+                   "save_aux": bool(save_aux), "generation": gen,
+                   "fs_shard": i, "fs_count": shards}
+            if epoch is not None:
+                man["epoch"] = int(epoch)
+            stream.save_npz(
+                fs_shard_path(path, i, shards), compress=False,
+                manifest=man, fault_point="ckpt.write",
+                row_lo=np.array(lo), row_hi=np.array(hi), **geom,
+                **{k: st[k][lo:hi] for k in saved})
+        # array-free stub LAST: its manifest is the generation's commit
+        # marker — a save torn between shard files leaves no stub
+        # manifest, so the generation reads as incomplete, never as a
+        # half-written table
+        man = {"learner": "sgd", "rows": n, "save_aux": bool(save_aux),
+               "generation": gen, "fs_count": shards}
+        if epoch is not None:
+            man["epoch"] = int(epoch)
+        stream.save_npz(path, compress=False, manifest=man,
+                        fault_point="ckpt.write", **geom)
         if keep > 0:
             import re
             m = re.search(r"_part-(\d+)", path)
@@ -492,6 +575,14 @@ class SlotStore:
                     raise ValueError(
                         f"checkpoint V_dim={ck_vdim} != configured "
                         f"V_dim={self.param.V_dim} ({path})")
+                if "fs_count" in z.files and "w" not in z.files:
+                    # per-key-range stub (save shards > 1): the table
+                    # lives in <path>_fs-<i>-of-<n> members — sweep the
+                    # stub's digests, then assemble from the shards
+                    fin()
+                    return self._load_sharded(
+                        path, int(z["fs_count"]), loaded, weights_only,
+                        verify)
                 # host-side zeros template — no device round trip: every
                 # key the checkpoint carries overwrites it in full, and
                 # the aux keys a non-aux checkpoint omits (z, sqrt_g, Vg)
@@ -554,6 +645,112 @@ class SlotStore:
             self._slots = np.arange(1, n + 1, dtype=np.int64)
             self._next_slot = n + 1
         return n
+
+    def _load_sharded(self, path: str, fs_count: int, loaded,
+                      weights_only: bool, verify: bool) -> int:
+        """Assemble the hashed table from its per-key-range shard files
+        (save shards > 1). Every shard is digest-verified BEFORE any
+        state commits; a missing or mismatched member raises the typed
+        :class:`CheckpointCorrupt` so loaders (auto_resume, task=serve)
+        walk back to the previous verified generation instead of
+        serving a half-assembled table. The assembled host columns are
+        placed back through ``_place`` — per-shard slices land straight
+        on their owning devices (parallel/mesh.py put_global), so the
+        round trip never builds a one-device global array."""
+        cap, k_dim = self.param.hash_capacity, self.param.V_dim
+        from ..parallel import fs_shard_bounds
+        try:
+            bounds = fs_shard_bounds(cap, fs_count)
+        except ValueError as e:
+            raise CheckpointCorrupt(path, str(e)) from e
+
+        def _aux(shape):
+            return np.broadcast_to(np.float32(0.0), shape)
+
+        az = _aux if weights_only else (lambda s: np.zeros(s, np.float32))
+        arr = {"w": np.zeros(cap, np.float32),
+               "z": az(cap),
+               "sqrt_g": az(cap),
+               "cnt": np.zeros(cap, np.float32),
+               "v_live": np.zeros(cap, bool),
+               "V": np.zeros((cap, k_dim), np.float32),
+               "Vg": az((cap, k_dim))}
+        for i, (lo, hi) in enumerate(bounds):
+            sp = fs_shard_path(path, i, fs_count)
+            try:
+                # shard members are always this codebase's writes: the
+                # stub declared fs_count, so a manifest-less shard is a
+                # torn save, not a legacy file
+                sctx = (mft.open_verified(sp, require_manifest=True,
+                                          fault_point="ckpt.read")
+                        if verify
+                        else stream.load_npz(sp, fault_point="ckpt.read"))
+            except FileNotFoundError as e:
+                raise CheckpointCorrupt(
+                    path, f"shard member {sp!r} is missing (torn or "
+                          f"partially pruned {fs_count}-shard save)") \
+                    from e
+            sfin = getattr(sctx, "finish", lambda: None)
+            with sctx as sz:
+                if (int(sz["hash_capacity"]) != cap
+                        or int(sz["fs_count"]) != fs_count
+                        or int(sz["row_lo"]) != lo
+                        or int(sz["row_hi"]) != hi):
+                    raise CheckpointCorrupt(
+                        sp, f"shard geometry disagrees with its stub "
+                            f"(expected rows [{lo}, {hi}) of {cap} over "
+                            f"{fs_count} shards)")
+                for k in loaded:
+                    if k in sz.files:
+                        a = sz[k]
+                        if np.asarray(a).shape[0] != hi - lo:
+                            raise CheckpointCorrupt(
+                                sp, f"array {k!r} has "
+                                    f"{np.asarray(a).shape[0]} rows, "
+                                    f"shard owns {hi - lo}")
+                        arr[k][lo:hi] = a
+                sfin()
+        nnz = int((arr["w"] != 0).sum())
+        self.state = self._place(self._assemble_state(arr, cap))
+        return nnz
+
+    def shard_stats(self) -> list:
+        """Per-key-range shard occupancy: [{shard, row_lo, row_hi, rows,
+        occupancy, table_bytes}] — ``rows`` counts non-zero-w slots in
+        the shard's range, ``table_bytes`` is the per-device HBM the
+        shard pins (updaters.state_bytes / fs). COLD path: reads the
+        full w column to the host — epoch boundaries, bench legs and
+        stats endpoints, never the dispatch loop."""
+        from ..updaters.sgd_updater import state_bytes
+        from ..parallel import fs_shard_bounds
+        st = self._state_np(self.state, keys=("w",))
+        fs = self.fs_count
+        bounds = fs_shard_bounds(self.state.capacity, fs)
+        per_dev = state_bytes(self.param, self.state.capacity) // fs
+        out = []
+        for i, (lo, hi) in enumerate(bounds):
+            rows = int((st["w"][lo:hi] != 0).sum())
+            out.append({"shard": i, "row_lo": lo, "row_hi": hi,
+                        "rows": rows,
+                        "occupancy": round(rows / max(hi - lo, 1), 6),
+                        "table_bytes": per_dev})
+        return out
+
+    def publish_shard_stats(self) -> list:
+        """shard_stats() pushed into the global metric registry
+        (``store_shard_rows`` / ``store_shard_occupancy`` gauges,
+        docs/observability.md) — called from cold paths only (see
+        shard_stats)."""
+        from ..obs import gauge
+        stats = self.shard_stats()
+        rows_g = gauge("store_shard_rows",
+                       "non-empty slot-table rows per fs key-range shard")
+        occ_g = gauge("store_shard_occupancy",
+                      "filled fraction of each fs key-range shard")
+        for s in stats:
+            rows_g.labels(shard=str(s["shard"])).set(s["rows"])
+            occ_g.labels(shard=str(s["shard"])).set(s["occupancy"])
+        return stats
 
     def dump(self, path: str, dump_aux: bool = False,
              need_reverse: bool = True) -> int:

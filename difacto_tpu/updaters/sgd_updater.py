@@ -249,6 +249,33 @@ def col_Vg(param: SGDUpdaterParam, state: SGDState) -> jnp.ndarray:
     return state.VVg[:, h:h + k]
 
 
+def state_bytes(param: SGDUpdaterParam, capacity: int) -> int:
+    """HBM bytes of the slot table at ``capacity`` rows — the number the
+    fs-sharding capacity story is about: per-device residency is
+    ``state_bytes / fs`` (parallel/mesh.py fs_shard_bounds), so an
+    fs-way mesh holds an fs-times-larger table in the same per-chip
+    HBM. One definition shared by bench.py's multichip capacity legs
+    and the store's shard stats."""
+    if param.V_dim == 0:
+        # four f32 columns (w, z, sqrt_g, cnt) + bool v_live
+        return capacity * (4 * 4 + 1)
+    _, _, Wx, _ = row_layout(param, capacity)
+    return capacity * Wx * (2 if param.V_dtype == "bfloat16" else 4)
+
+
+def gather_bytes(param: SGDUpdaterParam, capacity: int, u_cap: int) -> int:
+    """HBM bytes ONE direction of a fused row gather (or scatter) of
+    ``u_cap`` unique rows moves at this table capacity's row layout —
+    the per-dispatch unit of the ``store_gather_bytes_total`` counter
+    (docs/observability.md): serve counts it once per dispatch (pull
+    only), train twice (pull + push), so cross-shard row traffic is
+    observable per path."""
+    if param.V_dim == 0:
+        return u_cap * 3 * 4
+    _, _, Wx, _ = row_layout(param, capacity)
+    return u_cap * Wx * (2 if param.V_dtype == "bfloat16" else 4)
+
+
 def set_all_live(param: SGDUpdaterParam, state: SGDState) -> SGDState:
     """Bench/entry helper: activate every embedding row."""
     if param.V_dim == 0:

@@ -153,19 +153,14 @@ class _TracedJit:
         return getattr(self._fn, name)
 
 
-def jit(fun, **jit_kwargs):
-    """``jax.jit(fun, **jit_kwargs)``, traced when DIFACTO_JAXTRACE=1.
-
-    The jit-site identity is the creation site of THIS call
-    (``relpath:lineno``), byte-identical to the static jaxflow model's
-    site ids — that is what lets the tier-1 gate compare observed
-    compiles against the statically declared warm set."""
+def _wrap(fun, jit_kwargs: dict, site: str):
+    """Shared jit/pjit body: build the jax.jit wrapper and, when
+    tracing, stamp it with the CALLER's creation-site identity."""
     import jax
 
     wrapped = jax.jit(fun, **jit_kwargs)
     if not enabled():
         return wrapped
-    site = _site()
     statics = jit_kwargs.get("static_argnums", ())
     if isinstance(statics, int):
         statics = (statics,)
@@ -173,6 +168,29 @@ def jit(fun, **jit_kwargs):
     with _reg_mu:
         _sites.setdefault(site, _SiteStats(label))
     return _TracedJit(wrapped, site, frozenset(statics))
+
+
+def jit(fun, **jit_kwargs):
+    """``jax.jit(fun, **jit_kwargs)``, traced when DIFACTO_JAXTRACE=1.
+
+    The jit-site identity is the creation site of THIS call
+    (``relpath:lineno``), byte-identical to the static jaxflow model's
+    site ids — that is what lets the tier-1 gate compare observed
+    compiles against the statically declared warm set."""
+    return _wrap(fun, jit_kwargs, _site())
+
+
+def pjit(fun, **jit_kwargs):
+    """Sharded-jit creation with the SAME site identity contract as
+    :func:`jit`: ``jax.jit`` has absorbed pjit, so this forwards
+    ``in_shardings``/``out_shardings``/statics/donation to jax.jit —
+    but the call is *named* pjit so the static analyzer's jit-site
+    discovery (analysis/jaxflow.py ``_is_jit_name`` matches ``pjit`` /
+    ``*.pjit``) and this tracer agree on one ``relpath:lineno``
+    identity for the program. Mesh-sharded train/serve programs created
+    through here stay inside the jax-recompile / donation / host-sync
+    gates instead of dodging them behind a differently-named wrapper."""
+    return _wrap(fun, jit_kwargs, _site())
 
 
 def fetch(x, point: str = "") -> np.ndarray:

@@ -45,9 +45,11 @@ log = logging.getLogger("difacto_tpu")
 MANIFEST_SUFFIX = ".manifest.json"
 FORMAT = 1
 
-# the per-rank / per-epoch decorations learners append to a model prefix
-# (learners/sgd.py _model_name, lbfgs/bcd _ckpt_path)
-_DECOR_RE = re.compile(r"(?:_iter-\d+)?(?:_part-\d+)?(?:\.npz)?$")
+# the per-rank / per-epoch / per-fs-shard decorations learners append to
+# a model prefix (learners/sgd.py _model_name, lbfgs/bcd _ckpt_path,
+# store/local.py fs_shard_path)
+_DECOR_RE = re.compile(
+    r"(?:_iter-\d+)?(?:_part-\d+)?(?:_fs-\d+-of-\d+)?(?:\.npz)?$")
 _ITER_RE = re.compile(r"_iter-(\d+)")
 
 
@@ -303,6 +305,12 @@ def _family_manifests(uri: str) -> List[Tuple[int, str]]:
                 man = json.loads(f.read())
             gen = int(man.get("generation", 0))
         except (ValueError, OSError, KeyError):
+            continue
+        if man.get("fs_shard") is not None:
+            # per-key-range shard members (store/local.py fs_shard_path)
+            # are not load entry points: their generation's walk-back
+            # candidate is the undecorated stub, whose own load verifies
+            # every shard member
             continue
         out.append((gen, base))
     out.sort(key=lambda t: (-t[0], t[1]))

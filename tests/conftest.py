@@ -23,6 +23,28 @@ import pathlib
 
 import pytest
 
+# Known-environment guards (ISSUE 12 satellite): device-count / platform
+# dependent suites degrade to explicit SKIPS on boxes that cannot run
+# them, instead of joining the failure set and masking real regressions.
+#
+# Two-process jax.distributed runs (launch.py -n 2 workers) need a second
+# CPU core: on a 1-core container the pair starves and
+# multihost_utils.process_allgather fails inside the worker rather than
+# testing anything. Sharding tests that only need the 8-device VIRTUAL
+# mesh (this file's XLA flag) are unaffected and must not use this mark.
+two_process_launch = pytest.mark.skipif(
+    (os.cpu_count() or 1) < 2,
+    reason="two-process jax.distributed run needs >= 2 CPU cores "
+           "(1-core boxes fail in process_allgather, a known "
+           "environment limit, not a code regression)")
+
+# jax.shard_map moved between jax releases (jax.experimental.shard_map
+# in this image's build); suites written against the top-level name skip
+# until the learner migrates.
+requires_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="this jax build has no top-level jax.shard_map")
+
 
 def pytest_configure(config):
     # registered here (no pytest.ini): `slow` gates tier-2-only tests

@@ -22,11 +22,14 @@ import time
 
 import pytest
 
+from conftest import two_process_launch
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 EPOCHS = 4
 
 
 @pytest.mark.parametrize("mode,port", [("allgather", 7941), ("step", 7945)])
+@two_process_launch
 def test_kill_one_host_mid_epoch_recovers(rcv1_path, tmp_path, mode, port):
     """Both execution regimes: ``allgather`` kills rank 1 at a streamed
     epoch's DCN handshake; ``step`` kills it entering the first REPLAYED

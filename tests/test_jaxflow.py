@@ -115,6 +115,41 @@ def test_tracer_statics_key_by_value(monkeypatch):
     jaxtrace.reset()
 
 
+def test_pjit_same_site_identity_and_counts(monkeypatch):
+    """jaxtrace.pjit (sharded-jit creation, ISSUE 12) records the SAME
+    relpath:lineno site identity as jaxtrace.jit — mesh-sharded
+    programs stay inside the compile/transfer gates."""
+    monkeypatch.setenv("DIFACTO_JAXTRACE", "1")
+    jaxtrace.reset()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "fs"))
+    sh = NamedSharding(mesh, P("fs"))
+    f = jaxtrace.pjit(lambda x: x * 2, out_shardings=sh)
+    x = jax.device_put(jnp.ones(8), sh)
+    f(x)
+    f(x)
+    (site, rec), = jaxtrace.sites().items()
+    assert site.startswith("tests/test_jaxflow.py:")
+    assert rec["calls"] == 2 and rec["compiles"] == 1
+    jaxtrace.reset()
+
+
+def test_pjit_site_in_static_model(repo_model):
+    """The capacity sweep's jaxtrace.pjit creation site
+    (parallel/capacity.py) is discovered by the static model under the
+    same identity scheme and is warm-declared (reasoned suppression —
+    one compile per fs rung)."""
+    cap_sites = [s for s in repo_model.sites
+                 if s.startswith("difacto_tpu/parallel/capacity.py:")]
+    assert len(cap_sites) == 1, cap_sites
+    assert cap_sites[0] in repo_model.known_warm()
+    # its declared fetch point is known too
+    assert any(s.startswith("difacto_tpu/parallel/capacity.py:")
+               for s in repo_model.declared_fetches())
+
+
 def test_fetch_counts_and_dump_roundtrip(tmp_path, monkeypatch):
     monkeypatch.setenv("DIFACTO_JAXTRACE", "1")
     jaxtrace.reset()

@@ -981,12 +981,14 @@ def test_the_tree_is_clean(capsys):
     # in obs/trace.start_device), 6 wall-clock (cross-process file
     # timestamps x3, JSONL record stamps, trace-id entropy, run-dir
     # stamp), 2 lock-release (locktrace forwarding wrapper),
-    # 1 lock-blocking (native build serialization), 14 jax-recompile
+    # 1 lock-blocking (native build serialization), 15 jax-recompile
     # (pack/staging-time sticky caps the provenance model cannot chase
     # through payload tuples / the device cache; warm-replay keys;
-    # probe-tool per-variant compiles), 4 jax-host-sync
-    # (timing-harness completion fences in probe tools)
-    assert doc["counts"]["suppressed"] == 49
+    # probe-tool per-variant compiles; the capacity-scaling sweep's
+    # one-compile-per-fs-rung loop in parallel/capacity.py — the loop
+    # IS the benchmark matrix), 4 jax-host-sync (timing-harness
+    # completion fences in probe tools)
+    assert doc["counts"]["suppressed"] == 50
 
 
 # ---------------------------------------------------------------------------
@@ -1518,6 +1520,38 @@ def test_jax_recompile_suppressed_twin(tmp_path):
         "g(x, len(x))  # lint: ok(jax-recompile) probe harness")
     res = lint_src(tmp_path, src, ["jax-recompile"])
     assert res == []
+
+
+def test_jax_recompile_pjit_site_true_positive(tmp_path):
+    """pjit-named creation sites (jax pjit / jaxtrace.pjit with
+    shardings) are jit sites with the same identity — an unbounded
+    static through a sharded program is still a finding (ISSUE 12:
+    sharded train/serve programs must not dodge the gates)."""
+    found = lint_src(tmp_path, """
+        from difacto_tpu.utils import jaxtrace
+        def f(x, n):
+            return x
+        g = jaxtrace.pjit(f, static_argnums=(1,), in_shardings=None,
+                          out_shardings=None)
+        def hot(xs):
+            for x in xs:
+                g(x, len(x))
+    """, ["jax-recompile"])
+    assert len(found) == 1, found
+    assert "len(...)" in found[0].message
+
+
+def test_jax_recompile_pjit_bounded_is_clean(tmp_path):
+    assert lint_src(tmp_path, """
+        from difacto_tpu.utils import jaxtrace
+        def f(x, n):
+            return x
+        g = jaxtrace.pjit(f, static_argnums=(1,), donate_argnums=(0,))
+        CAP = 128
+        def hot(xs):
+            for x in xs:
+                g(x, CAP)
+    """, ["jax-recompile"]) == []
 
 
 def test_jax_recompile_jit_in_loop_and_immediate_invoke(tmp_path):

@@ -14,6 +14,11 @@ import sys
 import numpy as np
 
 from tests.test_bcd import OBJV_DIAG_NEWTON
+import pytest  # noqa: F401  (guard mark below)
+
+from conftest import two_process_launch
+
+pytestmark = two_process_launch
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 

@@ -15,6 +15,11 @@ import sys
 import numpy as np
 
 from tests.test_lbfgs import OBJV_BASIC
+import pytest  # noqa: F401  (guard mark below)
+
+from conftest import two_process_launch
+
+pytestmark = two_process_launch
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 

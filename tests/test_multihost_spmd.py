@@ -16,6 +16,10 @@ import sys
 import numpy as np
 import pytest
 
+from conftest import two_process_launch
+
+pytestmark = two_process_launch
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 EPOCHS = 4
 
