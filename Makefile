@@ -16,7 +16,8 @@ DATA_FORMAT ?= criteo
 DATA_OUT ?= $(basename $(DATA_IN)).rec
 
 .PHONY: test smoke ci lint lint-changed lint-baseline lockmap jitmap \
-	chaos fleet-chaos obs-report convert stream-bench multichip-bench
+	chaos fleet-chaos obs-report convert stream-bench multichip-bench \
+	kernel-parity
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -73,6 +74,14 @@ chaos:
 # docs/serving.md "Fleet operations")
 fleet-chaos:
 	$(PY) -m pytest tests/ -m chaos -q -k "fleet or router or rolling"
+
+# fused-kernel acceptance (ISSUE 13; docs/perf_notes.md "Fused FM
+# kernel"): byte-identical trajectories across fused_kernel={off, jnp,
+# pallas-if-available} at fs=1 and fs=4, on-device dedup parity vs the
+# host np.unique, and the pallas gather/scatter kernels bit-for-bit vs
+# the jnp contract (interpret mode off-TPU) — tier-1 time budget
+kernel-parity:
+	$(PY) -m pytest tests/test_fused.py -q -m 'not slow'
 
 smoke:
 	$(PY) bench.py --device-only --steps 2 --batch-size 128 --uniq 256 --capacity 1024 --vdim 4

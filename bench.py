@@ -36,7 +36,7 @@ MEASURED_HBM_GBPS = 87.0  # 1GiB stream mul+reduce, this chip via tunnel
 
 
 def build_step(V_dim: int, capacity: int, v_dtype: str,
-               chunks_sorted: bool = True):
+               chunks_sorted: bool = True, fused_kernel: str = "auto"):
     import dataclasses
 
     from difacto_tpu.losses import create
@@ -45,7 +45,8 @@ def build_step(V_dim: int, capacity: int, v_dtype: str,
                                                   make_fns)
 
     param = SGDUpdaterParam(V_dim=V_dim, V_threshold=0, lr=0.1, l1=1e-4,
-                            l2=1e-4, V_dtype=v_dtype)
+                            l2=1e-4, V_dtype=v_dtype,
+                            fused_kernel=fused_kernel)
     fns = make_fns(param)
     loss = create("fm", V_dim)
     if not chunks_sorted:
@@ -58,7 +59,7 @@ def build_step(V_dim: int, capacity: int, v_dtype: str,
     _, train_step, _ = make_step_fns(fns, loss)
     # raw (unjitted) step: the bench jits it with a donated state and
     # dispatches per step, the production replay pattern
-    return train_step, state
+    return train_step, state, fns, loss, param
 
 
 def make_batches(n: int, B: int, nnz_per_row: int, uniq_space: int,
@@ -156,6 +157,136 @@ def roofline(nnz: int, u_cap: int, V_dim: int, v_bytes: int,
         "stream_bw_gbps_this_chip": MEASURED_HBM_GBPS,
         "bw_fraction": round(total / dt_sec / 1e9 / MEASURED_HBM_GBPS, 3),
     }
+
+
+def run_kernel_bench(args, host_batches, nnz: int) -> dict:
+    """``kernel`` block (ISSUE 13 satellite): per-backend roofline
+    attribution of the fused v64 step. For every available
+    ``fused_kernel`` backend the FULL step is timed fresh (own table,
+    donated dispatch chain — same harness as the headline), emitting
+    examples/sec + ``bw_fraction``; then the step is split into its
+    four legs — dedup / gather / interaction (forward+backward from
+    pre-gathered rows) / scatter-update — each as its own jitted
+    program over the same staged batches, so BENCH_r* attributes the
+    roofline gap to a leg instead of guessing. Pallas is included only
+    on TPU backends (interpret mode is a parity harness, not a perf
+    number)."""
+    import jax
+    import jax.numpy as jnp
+
+    from difacto_tpu.losses import FMParams
+    from difacto_tpu.ops import fused as fused_ops
+    from difacto_tpu.utils import jaxtrace
+
+    v_bytes = 2 if args.vdtype == "bfloat16" else 4
+    backends = ["off", "jnp"]
+    if fused_ops.pallas_importable() and not fused_ops.interpret_mode():
+        backends.append("pallas")
+    steps = args.steps
+    out: dict = {"requested": args.fused_kernel, "backends": {},
+                 "measured": backends}
+
+    def _chain(step, state, batches, slots_l):
+        state, objv, _ = step(state, batches[0], slots_l[0])
+        jaxtrace.fetch(objv, point="bench.fence")
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, objv, _ = step(state, batches[i % len(batches)],
+                                  slots_l[i % len(slots_l)])
+        jaxtrace.fetch(objv, point="bench.fence")
+        return time.perf_counter() - t0, state
+
+    u_cap = len(host_batches[0][1])
+    for b in backends:
+        step_raw, state, _, _, _ = build_step(
+            args.vdim, args.capacity, args.vdtype, fused_kernel=b)
+        # lint: ok(jax-recompile) one jit per BACKEND leg — this loop
+        # IS the kernel-bench matrix (off/jnp/pallas); each leg
+        # compiles exactly once by construction
+        step = jax.jit(step_raw, donate_argnums=0)
+        batches = [jax.device_put(bb) for bb, _ in host_batches]
+        slots_l = [jnp.asarray(s) for _, s in host_batches]
+        dt, state = _chain(step, state, batches, slots_l)
+        vvg_cols = int(state.VVg.shape[1])
+        del state
+        roof = roofline(args.batch_size * nnz, u_cap, args.vdim,
+                        v_bytes, dt / steps, vvg_cols=vvg_cols)
+        out["backends"][b] = {
+            "examples_per_sec": round(steps * args.batch_size / dt, 1),
+            "bw_fraction": roof["bw_fraction"],
+            "approx_bytes_per_step": roof["approx_bytes_per_step"],
+        }
+
+    # ------------------------------------------------------------ legs
+    resolved = fused_ops.resolve_backend(
+        args.fused_kernel if args.fused_kernel != "off" else "auto",
+        V_dim=args.vdim)
+    step_raw, state, fns, loss, param = build_step(
+        args.vdim, args.capacity, args.vdtype, fused_kernel=resolved)
+    batches = [jax.device_put(bb) for bb, _ in host_batches]
+    slots_l = [jnp.asarray(s) for _, s in host_batches]
+    # token lanes in table-slot space: the device-dedup leg's input
+    toks = [jnp.asarray(np.asarray(s)[np.asarray(bb.idx).reshape(-1)])
+            for bb, s in host_batches]
+
+    dedup_fn = jax.jit(
+        lambda t: fused_ops.dedup_tokens(t, u_cap, args.capacity))
+    gather_fn = jax.jit(
+        lambda T, s: fused_ops.gather_rows(T, s, resolved))
+
+    def interact(state, rows, pb):
+        w, V, vm = fns.rows_to_params(state, rows)
+        params = FMParams(w=w, V=V, v_mask=vm)
+        pred, xv = loss.predict_xv(params, pb)
+        objv = loss.evaluate(pred, pb)
+        gw, gV = loss.calc_grad(params, pb, pred, xv)
+        return objv, gw, gV, vm
+
+    interact_fn = jax.jit(interact)
+    scatter_fn = jax.jit(fns.apply_grad_rows, donate_argnums=0)
+
+    n_bk = len(batches)
+    rows_l = [gather_fn(state.VVg, s) for s in slots_l]
+    grads_l = [interact_fn(state, rows_l[i], batches[i])
+               for i in range(n_bk)]
+
+    def _leg(fn, argsets, fence):
+        # warm + chain like the headline: async dispatch pipelines the
+        # RTT, the scalar fetch is the completion fence
+        r = fn(*argsets[0])
+        jaxtrace.fetch(fence(r), point="bench.fence")
+        t0 = time.perf_counter()
+        for i in range(steps):
+            r = fn(*argsets[i % len(argsets)])
+        jaxtrace.fetch(fence(r), point="bench.fence")
+        return (time.perf_counter() - t0) / steps * 1e3
+
+    legs = {
+        "dedup_ms": _leg(dedup_fn, [(t,) for t in toks],
+                         lambda r: r[2]),
+        "gather_ms": _leg(gather_fn,
+                          [(state.VVg, s) for s in slots_l],
+                          lambda r: r[0, 0]),
+        "interaction_ms": _leg(
+            interact_fn,
+            [(state, rows_l[i], batches[i]) for i in range(n_bk)],
+            lambda r: r[0]),
+    }
+    # scatter leg donates/rebinds the table state
+    _, gw0, gV0, vm0 = grads_l[0]
+    st = state
+    st = scatter_fn(st, slots_l[0], rows_l[0], gw0, gV0, vm0)
+    jaxtrace.fetch(fns.evaluate(st)[0], point="bench.fence")
+    t0 = time.perf_counter()
+    for i in range(steps):
+        j = i % n_bk
+        _, gw_i, gV_i, vm_i = grads_l[j]
+        st = scatter_fn(st, slots_l[j], rows_l[j], gw_i, gV_i, vm_i)
+    jaxtrace.fetch(fns.evaluate(st)[0], point="bench.fence")
+    legs["scatter_ms"] = (time.perf_counter() - t0) / steps * 1e3
+    out["legs_ms"] = {k: round(v, 3) for k, v in legs.items()}
+    out["legs_backend"] = resolved
+    return out
 
 
 def _gen_criteo_text(path: str, nrows: int, seed: int = 0) -> None:
@@ -519,6 +650,12 @@ def main() -> None:
                     help="feature frequency skew (criteo is heavy-tailed)")
     ap.add_argument("--vdtype", choices=("float32", "bfloat16"),
                     default="bfloat16")
+    ap.add_argument("--fused-kernel", default="auto",
+                    choices=("auto", "pallas", "jnp", "off"),
+                    help="table-kernel backend of the fused step "
+                         "(updaters/sgd_updater.py fused_kernel): the "
+                         "headline rides this; the kernel block times "
+                         "every available backend regardless")
     ap.add_argument("--steps", type=int, default=40)
     mode = ap.add_mutually_exclusive_group()
     mode.add_argument("--e2e", action="store_true",
@@ -592,9 +729,11 @@ def main() -> None:
         dp, fs = (int(v) for v in args.mesh.lower().split("x"))
         mesh = make_mesh(dp=dp, fs=fs)
 
-    step_raw, state = build_step(args.vdim, args.capacity, args.vdtype,
-                                 chunks_sorted=mesh is None
-                                 or mesh.shape["dp"] == 1)
+    step_raw, state, _, _, _ = build_step(
+        args.vdim, args.capacity, args.vdtype,
+        chunks_sorted=mesh is None or mesh.shape["dp"] == 1,
+        fused_kernel=args.fused_kernel if mesh is None else
+        ("jnp" if args.fused_kernel == "pallas" else args.fused_kernel))
     host_batches = make_batches(4, args.batch_size, args.nnz_per_row,
                                 args.uniq, args.capacity, args.dist,
                                 chunk_multiple=(mesh.shape["dp"]
@@ -659,6 +798,12 @@ def main() -> None:
                              args.vdim, v_bytes, dt / args.steps,
                              vvg_cols=int(state.VVg.shape[1])),
     }
+    if mesh is None and args.vdim > 0:
+        # per-backend roofline attribution of the fused step (ISSUE 13):
+        # every available fused_kernel backend full-step timed, plus the
+        # dedup/gather/interaction/scatter leg split
+        out["kernel"] = run_kernel_bench(args, host_batches,
+                                         args.nnz_per_row)
     if not args.device_only and mesh is None:
         # the product number rides the default output so a pipeline
         # regression is driver-visible (round-3 verdict #10)

@@ -131,10 +131,24 @@ def chunk_host(i32: np.ndarray, f32: np.ndarray, b_cap: int,
     return panel_chunk_tokens_np(i32[:cells], fv, u_cap, b_cap, width)
 
 
+def _count_distinct(tok: np.ndarray, hash_capacity: int) -> int:
+    """Exact distinct-token count WITHOUT the sort ``np.unique`` pays:
+    an O(nnz + capacity) flag pass when the capacity-sized bool array
+    is cheap, the sort fallback above that (still skips the inverse
+    map + O(nnz) remap, the other half of the host dedup cost). Sizes
+    the device-dedup path's sticky u-cap (prepare_hashed)."""
+    if hash_capacity <= (1 << 24):
+        seen = np.zeros(hash_capacity, dtype=bool)
+        seen[tok] = True
+        return int(seen.sum())
+    return len(np.unique(tok))
+
+
 def prepare_hashed(shapes: ShapeSchedule, hash_capacity: int, blk,
                    want_counts: bool, fill_counts: bool, dim_min: int,
                    job: str, b_cap: Optional[int] = None,
-                   stream_chunk: bool = False):
+                   stream_chunk: bool = False,
+                   device_dedup: bool = False):
     """Producer batch preparation for the hashed store: ONE int32
     np.unique collapses localization (Localizer::Compact), key->slot
     mapping, and collision dedup, then the batch packs into the
@@ -145,11 +159,34 @@ def prepare_hashed(shapes: ShapeSchedule, hash_capacity: int, blk,
     ``want_counts`` keeps the packed counts section (and thus the step's
     jit signature) present for the WHOLE run; ``fill_counts`` (epoch 0
     only) computes real occurrence counts — later epochs ship an all-zero
-    section, making apply_count a no-op instead of a recompile."""
+    section, making apply_count a no-op instead of a recompile.
+
+    ``device_dedup`` (ISSUE 13): ship RAW hashed token lanes and let the
+    jit step run the sort + run-length dedup on device
+    (ops/fused.dedup_tokens) — the host pays only the hash and an
+    O(nnz + capacity) distinct-count flag pass (_count_distinct), not
+    the O(nnz log nnz) sort + inverse + remap. Engages only on
+    panel-shaped TRAINING batches past the count push (fill_counts
+    forces the host path: counts need the host inverse) — COO-shaped
+    batches fall back to host dedup. The u-cap is sized with a +1
+    margin because pad cells introduce the TRASH lane on device."""
     from ..base import reverse_bytes
     from ..store.local import hash_slots, pad_slots_oob
 
     tok = hash_slots(reverse_bytes(blk.index), hash_capacity)
+    if device_dedup and not fill_counts:
+        from ..ops.batch import pack_panel_raw, panel_width
+        b_cap_raw = b_cap or shapes.cap(job + ".b", blk.size, dim_min)
+        cblk = dataclasses.replace(blk, index=tok.astype(np.uint32))
+        width = panel_width(cblk, b_cap_raw)
+        if width is not None:
+            n_uniq = _count_distinct(tok, hash_capacity)
+            u_cap = shapes.cap(job + ".u", n_uniq + 1)
+            width = shapes.cap(job + ".w", width, exact=True)
+            i32, f32, binary = pack_panel_raw(cblk, n_uniq, b_cap_raw,
+                                              width)
+            return ("panel_raw", i32, f32, binary, b_cap_raw, width,
+                    u_cap)
     if fill_counts:
         slots, inverse, counts = np.unique(
             tok, return_inverse=True, return_counts=True)
@@ -222,6 +259,9 @@ class StreamSpec:
     b_cap: Optional[int]
     stream_chunk: bool
     need_label: bool
+    # ship raw hashed token lanes; the jit step dedups on device
+    # (prepare_hashed device_dedup — ISSUE 13)
+    device_dedup: bool = False
     caps: dict = field(default_factory=dict)
     # the consumer's trace id (obs/trace.py): spawned workers adopt it so
     # their parse/pack spans join the parent's timeline in one trace file
@@ -312,4 +352,5 @@ def spec_iter(spec: StreamSpec, part_i: int) -> Iterator:
         yield ("ready", info(blk), packed(
             prepare_hashed, shapes, spec.hash_capacity, blk,
             spec.want_counts, spec.fill_counts, spec.dim_min, spec.job,
-            spec.b_cap, stream_chunk=spec.stream_chunk))
+            spec.b_cap, stream_chunk=spec.stream_chunk,
+            device_dedup=spec.device_dedup))

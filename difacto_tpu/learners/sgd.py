@@ -271,6 +271,18 @@ class SGDLearnerParam(Param):
     # ON DEVICE per step was also measured out (221 ms/step). Numbers:
     # docs/perf_notes.md "streamed chunking".
     stream_chunks: bool = False
+    # STREAMED hashed training: ship RAW hashed token lanes and run the
+    # unique-key dedup ON DEVICE (sort + run-length segment ids inside
+    # the jit step, ops/fused.dedup_tokens) instead of the producer's
+    # np.unique — the host pays only the hash plus an O(nnz+capacity)
+    # distinct-count flag pass, shrinking the pack stage further
+    # (ISSUE 13). Engages on panel-shaped training batches past the
+    # epoch-0 count push while no replay cache may stage (the cache's
+    # target regime replays from HBM anyway) and stream_chunks is off
+    # (the chunked layout needs the host inverse). OFF by default: it
+    # trades device sort time for host pack time, which only pays when
+    # the producer cores are the bottleneck (the >HBM streamed regime).
+    device_dedup: bool = False
     # HBM budget for the device-resident batch replay cache (0 disables).
     # Single-host hashed-store runs stage each packed batch once and replay
     # it from device memory every later epoch — essential when the
@@ -557,6 +569,30 @@ class SGDLearner(Learner):
         self._packed_panel_train_chunked = jaxtrace.jit(
             packed_panel_train_chunked, donate_argnums=0,
             static_argnums=(6, 7, 8, 9, 10))
+
+        def packed_panel_train_raw(state, i32, f32, b_cap, width, u_cap,
+                                   binary):
+            # device-dedup streamed path (ISSUE 13): the payload's idx
+            # cells are RAW hashed tokens; the sorted-unique slot
+            # vector (OOB-padded, the kernel contract) and the inverse
+            # index map are derived here, on device, per step. No
+            # counts section — the raw path only engages past the
+            # epoch-0 count push, where the zero-count apply_count is a
+            # bit-level no-op (the pair-replay program omits it on the
+            # same argument, _warm_pair_exec).
+            from ..ops.batch import unpack_panel_raw
+            from ..ops.fused import dedup_tokens
+            pb = unpack_panel_raw(i32, f32, b_cap, width, binary)
+            cells = b_cap * width
+            slots, inverse, n = dedup_tokens(i32[:cells], u_cap,
+                                             state.capacity)
+            pb = pb._replace(idx=inverse.reshape(b_cap, width),
+                             num_uniq=n)
+            return train_step(state, pb, slots)
+
+        self._packed_panel_train_raw = jaxtrace.jit(
+            packed_panel_train_raw, donate_argnums=0,
+            static_argnums=(3, 4, 5, 6))
 
         def packed_panel_train_chunked2(state, pa, pb, b_cap, width,
                                         u_cap, has_cnt, binary):
@@ -1257,6 +1293,17 @@ class SGDLearner(Learner):
                     self.store.state, slots_dev, cts_dev)
             from ..step import fire_step_fault
             fire_step_fault()
+            # table row traffic of this synchronized step (PR 12
+            # leftover: the SPMD drain path never counted it): the
+            # replicated global slot union is pulled once — and pushed
+            # once when training — at the fused-row width
+            # (updaters.gather_bytes; docs/observability.md)
+            from ..updaters.sgd_updater import gather_bytes
+            per_dir = gather_bytes(self.store.param,
+                                   self.store.state.capacity,
+                                   slots_dev.shape[0])
+            self._gather_c.inc(
+                per_dir * (2 if job_type == K_TRAINING else 1))
             if job_type == K_TRAINING:
                 self.store.state, objv, auc = self._train_step(
                     self.store.state, batch, slots_dev)
@@ -1316,14 +1363,16 @@ class SGDLearner(Learner):
     def _prepare_hashed(self, blk, want_counts: bool, fill_counts: bool,
                         dim_min: int, job: str,
                         b_cap: Optional[int] = None,
-                        stream_chunk: bool = False):
+                        stream_chunk: bool = False,
+                        device_dedup: bool = False):
         """Producer batch preparation for the hashed store — delegates to
         the shared pipeline definition (data/pack_stream.prepare_hashed)
         so the thread and process transports pack identically."""
         from ..data.pack_stream import prepare_hashed
         return prepare_hashed(self._shapes, self.store.param.hash_capacity,
                               blk, want_counts, fill_counts, dim_min, job,
-                              b_cap, stream_chunk=stream_chunk)
+                              b_cap, stream_chunk=stream_chunk,
+                              device_dedup=device_dedup)
 
     def _pack_payload(self, cblk, n_lanes, padded, b_cap, dim_min: int,
                       job: str, counts=None,
@@ -1524,7 +1573,8 @@ class SGDLearner(Learner):
             wkey = job + ".w"
         else:
             b_cap, d2, u_cap = payload[4], payload[5], payload[6]
-            wkey = job + (".w" if payload[0] == "panel" else ".nnz")
+            wkey = job + (".w" if payload[0] in ("panel", "panel_raw")
+                          else ".nnz")
         self._shapes.absorb({job + ".b": b_cap, wkey: d2,
                              job + ".u": u_cap})
 
@@ -1825,6 +1875,15 @@ class SGDLearner(Learner):
                            and not cache.frozen)
         stream_chunk = (is_train and hashed_fast and p.stream_chunks
                         and not cache_may_stage)
+        # on-device unique-key dedup (ISSUE 13): raw token lanes +
+        # in-step sort — streamed hashed training only, past the
+        # epoch-0 count push (prepare_hashed also guards fill_counts),
+        # never while a cache may stage (its regime replays from HBM)
+        # and never with stream_chunks (the chunked layout needs the
+        # host inverse). See SGDLearnerParam.device_dedup.
+        device_dedup = (is_train and hashed_fast and p.device_dedup
+                        and not stream_chunk and not cache_may_stage
+                        and not push_cnt)
 
         from ..data.pack_stream import timed_reader
         from ..obs import trace
@@ -1873,7 +1932,8 @@ class SGDLearner(Learner):
                         part, self._prepare_hashed, blk, want_counts,
                         push_cnt, dim_min, job,
                         b_cap_train if is_train else None,
-                        stream_chunk=stream_chunk))
+                        stream_chunk=stream_chunk,
+                        device_dedup=device_dedup))
                 else:
                     yield ("compact", blk, packed(
                         part, compact, blk, need_counts=push_cnt))
@@ -1909,6 +1969,7 @@ class SGDLearner(Learner):
                 want_counts=want_counts, fill_counts=push_cnt,
                 dim_min=dim_min, job=job, b_cap=b_cap_train,
                 stream_chunk=stream_chunk, need_label=False,
+                device_dedup=device_dedup,
                 caps=self._shapes.snapshot(),
                 trace_id=trace.trace_id())
             slot_mb = p.ring_slot_mb or max(
@@ -2084,6 +2145,15 @@ class SGDLearner(Learner):
             return
         (layout, i32, f32, b_cap, d2, u_cap, want_counts, binary,
          nrows) = payload
+        if layout == "panel_raw":
+            # device-dedup streamed payload (train-only by the
+            # _iterate_parts gate): raw token lanes, slots + inverse
+            # derived in-step (ops/fused.dedup_tokens)
+            # lint: ok(jax-recompile) sticky pack-time caps (above)
+            self.store.state, objv, auc = self._packed_panel_train_raw(
+                self.store.state, i32, f32, b_cap, d2, u_cap, binary)
+            pending.append((nrows, objv, auc))
+            return
         if layout == "panel":
             if is_train:
                 # lint: ok(jax-recompile) payload statics are sticky
@@ -2298,7 +2368,7 @@ class SGDLearner(Learner):
                            binary, blk.size)
         self._dispatch_packed(job_type, dev_payload, pending,
                               label=blk.label)
-        if cache is not None and cache.staging:
+        if cache is not None and cache.staging and layout != "panel_raw":
             # keep the staged buffers for HBM replay; the counts tail
             # (epoch-0 feature-count push) is zeroed on device so a
             # replayed step never re-counts

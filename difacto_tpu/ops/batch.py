@@ -219,6 +219,66 @@ def unpack_panel(i32, f32, batch_cap: int, width: int, u_cap: int,
     return pb, slots, counts
 
 
+def pack_panel_raw(blk: RowBlock, num_uniq: int, batch_cap: int,
+                   width: int):
+    """Device-dedup panel payload (ISSUE 13): the block's index cells are
+    RAW hashed slot tokens (hash_slots output, NOT localized lanes) and
+    there is no slots section — the jit step derives the sorted-unique
+    slot vector and the inverse map on device (ops/fused.dedup_tokens),
+    so the producer skips the O(nnz log nnz) host ``np.unique``.
+
+    i32 = [tok(B*F) | b, num_uniq]; f32 = [vals(B*F)? | labels(B) |
+    rweight(B) | row_mask(B)]. ``num_uniq`` is the host's cheap distinct
+    count (pack_stream._count_distinct) — it sizes the sticky u-cap, the
+    device recomputes the exact lane count. Pad cells carry token 0
+    (TRASH_SLOT), whose gathered row is the all-zero trash row and whose
+    gradient contribution is zero (vals 0), so the extra lane it may add
+    is trajectory-inert. No counts section: the raw path only engages on
+    epochs past the count push (pack_stream.prepare_hashed)."""
+    idx, vals, labels, rweight, row_mask = _panel_arrays(blk, batch_cap,
+                                                         width)
+    binary = vals is None
+    cells = batch_cap * width
+    i32 = np.empty(cells + 2, dtype=np.int32)
+    i32[:cells] = idx.reshape(-1)
+    i32[cells:] = (blk.size, num_uniq)
+    vals_n = 0 if binary else cells
+    f32 = np.zeros(max(vals_n + 3 * batch_cap, 1), dtype=REAL_DTYPE)
+    o = 0
+    if not binary:
+        f32[:cells] = vals.reshape(-1)
+        o = cells
+    f32[o:o + batch_cap] = labels
+    o += batch_cap
+    f32[o:o + batch_cap] = rweight
+    o += batch_cap
+    f32[o:o + batch_cap] = row_mask
+    return i32, f32, binary
+
+
+def unpack_panel_raw(i32, f32, batch_cap: int, width: int,
+                     binary: bool = False):
+    """jit-traceable inverse of pack_panel_raw -> (PanelBatch with RAW
+    token idx cells, num_uniq meta). The caller runs dedup_tokens over
+    the flat cells and rewrites ``idx`` to the localized inverse."""
+    cells = batch_cap * width
+    idx = i32[:cells].reshape(batch_cap, width)
+    meta = i32[cells:]
+    o = 0
+    vals = None
+    if not binary:
+        vals = f32[:cells].reshape(batch_cap, width)
+        o = cells
+    labels = f32[o:o + batch_cap]
+    o += batch_cap
+    rweight = f32[o:o + batch_cap]
+    o += batch_cap
+    row_mask = f32[o:o + batch_cap]
+    return PanelBatch(idx=idx, vals=vals, labels=labels, rweight=rweight,
+                      row_mask=row_mask, num_rows=meta[0],
+                      num_uniq=meta[1])
+
+
 # Chunk length of the run-chunked backward layout. L=16 measured fastest at
 # bench shapes (L=8: more chunks to scatter; L=32/64: more gather padding
 # on the zipf run-length distribution — docs/perf_notes.md).

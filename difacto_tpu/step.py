@@ -59,22 +59,37 @@ def make_step_fns(fns, loss: LossSpec, train_auc: str = "binned",
     all three returned callables are pure and jit-ready.
     ``state_shardings`` (mesh runs) pins the returned state to the
     table's fs key-range layout — see :func:`state_constrainer`.
+
+    With a fused table backend (``fns.fused`` — fused_kernel=jnp or
+    pallas, ops/fused.py) the train step takes the fused dataflow:
+    ONE row gather whose result is THREADED from the pull to the push
+    (apply_grad_rows), so the push never re-gathers — the composed
+    ("off") path instead relies on XLA CSE to merge its two gathers.
+    Identical primitives either way: trajectories are byte-identical
+    across backends (tests/test_fused.py).
     """
     constrain = state_constrainer(state_shardings)
+    fused = bool(getattr(fns, "fused", False))
 
     def pull(state, batch, slots):
+        """(params, slot_vmask, rows-or-None): the fused backends keep
+        the gathered rows so train_step can hand them to the push."""
+        if fused:
+            rows = fns.pull_rows(state, slots)
+            w, V, vmask = fns.rows_to_params(state, rows)
+            return FMParams(w=w, V=V, v_mask=vmask), vmask, rows
         w, V, vmask = fns.get_rows(state, slots)
-        return FMParams(w=w, V=V, v_mask=vmask), vmask
+        return FMParams(w=w, V=V, v_mask=vmask), vmask, None
 
     def forward(state, batch, slots):
-        params, _ = pull(state, batch, slots)
+        params, _, _ = pull(state, batch, slots)
         pred = loss.predict(params, batch)
         objv = loss.evaluate(pred, batch)
         auc = auc_times_n_jnp(batch.labels, pred, batch.row_mask)
         return params, pred, objv, auc
 
     def train_step(state, batch, slots):
-        params, slot_vmask = pull(state, batch, slots)
+        params, slot_vmask, rows = pull(state, batch, slots)
         # the forward hands its X·V to the backward so the fused step
         # gathers the [U, 1+k] token rows exactly once (round-4 profile:
         # the duplicate gather was ~15% of the step)
@@ -87,7 +102,11 @@ def make_step_fns(fns, loss: LossSpec, train_auc: str = "binned",
         else:
             auc = jnp.float32(0.0)
         gw, gV = loss.calc_grad(params, batch, pred, xv)
-        state = fns.apply_grad(state, slots, gw, gV, slot_vmask)
+        if fused:
+            state = fns.apply_grad_rows(state, slots, rows, gw, gV,
+                                        slot_vmask)
+        else:
+            state = fns.apply_grad(state, slots, gw, gV, slot_vmask)
         return constrain(state), objv, auc
 
     def eval_step(state, batch, slots):

@@ -108,7 +108,9 @@ class SlotStore:
                  initial_capacity: Optional[int] = None, mesh=None,
                  read_only: bool = False):
         self.param = param
-        self.fns = make_fns(param)
+        # the mesh gates fused_kernel backend resolution: the pallas
+        # table kernels require an unsharded table (ops/fused.py)
+        self.fns = make_fns(param, mesh=mesh)
         self.mesh = mesh
         # read-only stores serve inference (serve/, task=pred): lookups
         # never insert into the dictionary, push/apply paths raise, and

@@ -87,6 +87,20 @@ class SGDUpdaterParam(Param):
     # the compact layout.
     pad_v_rows: bool = True
     pad_v_rows_max_mb: int = 1536
+    # table-kernel backend of the fused SGD hot path (ops/fused.py):
+    # "off" = the composed gather/scatter ops (pull and push gathers
+    # merged only by XLA CSE); "jnp" = the fused single-program path
+    # (the step threads the gathered rows from pull to push and the
+    # FTRL/AdaGrad epilogue scatters once — byte-identical
+    # trajectories, guaranteed single gather); "pallas" = the same
+    # dataflow as pl.pallas_call DMA kernels with the row update folded
+    # into the scatter's epilogue (TPU backends; interpret-mode parity
+    # elsewhere; unsharded tables only); "auto" = jnp until a driver
+    # bench shows the pallas kernels ahead (docs/perf_notes.md "Fused
+    # FM kernel").
+    fused_kernel: str = field(default="auto",
+                              metadata=dict(enum=["auto", "pallas",
+                                                  "jnp", "off"]))
 
 
 class SGDState(NamedTuple):
@@ -358,29 +372,93 @@ def grow_state(param: SGDUpdaterParam, state: SGDState, new_capacity: int
                       for a, b in zip(state, ext)))
 
 
-def make_fns(param: SGDUpdaterParam):
+def ftrl_w(w, z, sg, gw, l1: float, l2: float, lr: float, lr_beta: float):
+    """The FTRL-proximal w update (UpdateW, sgd_updater.cc:105-131),
+    identical math in both layouts. Module-level so every fused_kernel
+    backend traces the SAME op sequence (ops/fused.py)."""
+    g = gw + l2 * w
+    sg_new = jnp.sqrt(sg * sg + g * g)
+    z_new = z - (g - (sg_new - sg) / lr * w)
+    eta = (lr_beta + sg_new) / lr
+    w_new = jnp.where(
+        jnp.abs(z_new) <= l1, 0.0,
+        (z_new - jnp.sign(z_new) * l1) / eta)
+    return w_new, z_new, sg_new
+
+
+def row_epilogue(param: SGDUpdaterParam, capacity: int, rows: jnp.ndarray,
+                 gw: jnp.ndarray, gV: Optional[jnp.ndarray],
+                 pull_vmask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """The per-row FTRL(w) + AdaGrad(V) update on gathered fused rows
+    [n, Wx] -> new rows, WITHOUT the surrounding gather/scatter: the
+    single source of the push math for every fused_kernel backend —
+    the off/jnp paths scatter its result, the pallas kernel traces it
+    per R-row VMEM tile as the scatter's epilogue (ops/fused.py
+    fm_update_rows). ``pull_vmask`` gates AdaGrad to rows whose
+    embedding was PULLED this batch (lens[i] > 1 semantics,
+    sgd_updater.cc:91-96); padded OOB lanes compute garbage that the
+    scatter drops."""
+    k, h, _, off = row_layout(param, capacity)
+    thr = float(param.V_threshold)
+    w, z, sg, cnt, live = unpack_scal(rows[:, off:])
+    w_new, z_new, sg_new = ftrl_w(w, z, sg, gw, param.l1, param.l2,
+                                  param.lr, param.lr_beta)
+    # lazy-V activation on the touched rows (the union of the
+    # reference's two trigger sites re-evaluated after the update)
+    live_new = live | ((w_new != 0) & (cnt > thr))
+    scal = pack_scal(w_new, z_new, sg_new, cnt, live_new, rows.dtype)
+
+    if gV is not None:
+        V = rows[:, :k].astype(jnp.float32)
+        Vg = rows[:, h:h + k].astype(jnp.float32)
+        gv = gV + param.V_l2 * V
+        Vg_new = jnp.sqrt(Vg * Vg + gv * gv)
+        V_new = V - param.V_lr / (Vg_new + param.V_lr_beta) * gv
+        # AdaGrad only touches rows whose embedding was PULLED this
+        # batch (lens[i] > 1 semantics, sgd_updater.cc:91-96)
+        upd = pull_vmask[:, None] > 0
+        emb = jnp.where(upd, fuse_vvg(V_new, Vg_new, h),
+                        rows[:, :2 * h].astype(jnp.float32)
+                        ).astype(rows.dtype)
+    else:
+        emb = rows[:, :2 * h]
+    # in-pad layout: scal replaces the tail of emb's own pad lanes;
+    # appended layout: the gap lanes between are carried through
+    if off <= 2 * h:
+        return jnp.concatenate([emb[:, :off], scal], axis=1)
+    return jnp.concatenate([emb, rows[:, 2 * h:off], scal], axis=1)
+
+
+def make_fns(param: SGDUpdaterParam, mesh=None):
     """Build the pure update/get functions with hyperparameters baked in
     as compile-time constants. Returns a namespace of jit-ready callables
-    (not yet jit-wrapped; the store/learner composes and jits them)."""
+    (not yet jit-wrapped; the store/learner composes and jits them).
+
+    ``mesh`` (the store's SPMD mesh, or None) gates the fused_kernel
+    backend resolution: the pallas kernels require an unsharded table
+    (ops/fused.py resolve_backend)."""
+
+    from ..ops import fused
 
     l1, l2 = param.l1, param.l2
     lr, lr_beta = param.lr, param.lr_beta
-    V_l2, V_lr, V_lr_beta = param.V_l2, param.V_lr, param.V_lr_beta
     has_V = param.V_dim > 0
+    # table-kernel backend of the V>0 hot path ("off" on flat tables —
+    # there is no fused row to kernel over); see SGDUpdaterParam.
+    # V_l2 / V_lr / V_lr_beta are read by row_epilogue from ``param``.
+    backend = fused.resolve_backend(param.fused_kernel, mesh=mesh,
+                                    V_dim=param.V_dim)
 
     def _gather(arr, slots):
         # the store guarantees sorted unique slots (map_keys_dedup) with
-        # out-of-bounds ASCENDING padding (pad_slots) — the flags let XLA
-        # skip duplicate handling in the TPU lowering (measured ~20% off
+        # out-of-bounds ASCENDING padding (pad_slots) — the gather-flag
+        # contract lives in ops/fused.gather_rows (measured ~20% off
         # the fused step); padded lanes read as zeros (mode=fill)
-        return arr.at[slots].get(indices_are_sorted=True,
-                                 unique_indices=True,
-                                 mode="fill", fill_value=0)
+        return fused.gather_rows(arr, slots, "jnp")
 
     def _scatter(arr, slots, rows):
         # padded (out-of-bounds) entries are dropped, real rows are unique
-        return arr.at[slots].set(rows, indices_are_sorted=True,
-                                 unique_indices=True, mode="drop")
+        return fused.scatter_rows(arr, slots, rows, "jnp")
 
     thr = float(param.V_threshold)
 
@@ -388,16 +466,29 @@ def make_fns(param: SGDUpdaterParam):
         return row_layout(param, state.capacity)
 
     def _ftrl(w, z, sg, gw):
-        """The FTRL-proximal w update (UpdateW, sgd_updater.cc:105-131),
-        identical math in both layouts."""
-        g = gw + l2 * w
-        sg_new = jnp.sqrt(sg * sg + g * g)
-        z_new = z - (g - (sg_new - sg) / lr * w)
-        eta = (lr_beta + sg_new) / lr
-        w_new = jnp.where(
-            jnp.abs(z_new) <= l1, 0.0,
-            (z_new - jnp.sign(z_new) * l1) / eta)
-        return w_new, z_new, sg_new
+        return ftrl_w(w, z, sg, gw, l1, l2, lr, lr_beta)
+
+    def pull_rows(state: SGDState, slots: jnp.ndarray) -> jnp.ndarray:
+        """ONE full fused-row gather of the batch's unique slots,
+        backend-dispatched (ops/fused.py). The fused train step
+        (step.py) threads the result from pull to push so the push
+        never re-gathers — the "off" path instead relies on XLA CSE
+        merging its two gathers. A partial-row gather (VVg[slots, :k])
+        would lower to a strided gather ~8x slower. V keeps its
+        STORAGE dtype (param.V_dtype) so the loss's per-token gather
+        can ride bf16."""
+        return fused.gather_rows(state.VVg, slots, backend)
+
+    def rows_to_params(state: SGDState, rows: jnp.ndarray):
+        """(w, V, v_mask) views of gathered fused rows (Get,
+        sgd_updater.cc:34-58): the embedding is served only when live
+        and not suppressed by ``l1_shrk`` (w == 0)."""
+        _, _, _, off = _layout(state)
+        w, _, _, _, live = unpack_scal(rows[:, off:])
+        vmask = live
+        if param.l1_shrk:
+            vmask = vmask & (w != 0)
+        return w, rows[:, :param.V_dim], vmask.astype(jnp.float32)
 
     def get_rows(state: SGDState, slots: jnp.ndarray
                  ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
@@ -405,18 +496,7 @@ def make_fns(param: SGDUpdaterParam):
         """Pull [w, V, v_mask] rows for the batch's unique slots (Get)."""
         if not has_V:
             return _gather(state.w, slots), None, None
-        # ONE full fused-row gather serves w, V AND the live flag; it is
-        # CSE'd with apply_grad's gather of the same rows in the fused
-        # train step. A partial-row gather (VVg[slots, :k]) would lower
-        # to a strided gather ~8x slower. V keeps its STORAGE dtype
-        # (param.V_dtype) so the loss's per-token gather can ride bf16.
-        _, _, _, off = _layout(state)
-        rows = _gather(state.VVg, slots)
-        w, _, _, _, live = unpack_scal(rows[:, off:])
-        vmask = live
-        if param.l1_shrk:
-            vmask = vmask & (w != 0)
-        return w, rows[:, :param.V_dim], vmask.astype(jnp.float32)
+        return rows_to_params(state, pull_rows(state, slots))
 
     def apply_count(state: SGDState, slots: jnp.ndarray, counts: jnp.ndarray
                     ) -> SGDState:
@@ -438,11 +518,37 @@ def make_fns(param: SGDUpdaterParam):
         out = jnp.concatenate([rows[:, :off], scal], axis=1)
         return state._replace(VVg=_scatter(state.VVg, slots, out))
 
+    def apply_grad_rows(state: SGDState, slots: jnp.ndarray,
+                        rows: jnp.ndarray, gw: jnp.ndarray,
+                        gV: Optional[jnp.ndarray],
+                        pull_vmask: Optional[jnp.ndarray]) -> SGDState:
+        """Fused kGradient push over rows the step ALREADY gathered
+        (pull_rows): the per-row FTRL/AdaGrad epilogue (row_epilogue)
+        plus ONE scatter. The pallas backend folds the epilogue into
+        the scatter kernel itself (ops/fused.fm_update_rows), so the
+        table row moves through HBM exactly once on the push."""
+        cap = state.capacity
+
+        def epi(r, g, gv, vm):
+            return row_epilogue(param, cap, r, g, gv, vm)
+
+        if backend == "pallas" and gV is not None \
+                and pull_vmask is not None:
+            VVg = fused.fm_update_rows(state.VVg, slots, rows, gw, gV,
+                                       pull_vmask, epi, backend="pallas")
+        else:
+            VVg = _scatter(state.VVg, slots,
+                           epi(rows, gw, gV, pull_vmask))
+        return state._replace(VVg=VVg)
+
     def apply_grad(state: SGDState, slots: jnp.ndarray,
                    gw: jnp.ndarray, gV: Optional[jnp.ndarray],
                    pull_vmask: Optional[jnp.ndarray]) -> SGDState:
         """kGradient push: FTRL(w) + AdaGrad(V). ``slots`` are sorted unique
-        (padding -> TRASH_SLOT, whose gw must be 0)."""
+        (padding -> TRASH_SLOT, whose gw must be 0). Gathers the fused
+        rows itself (the "off" path's second gather, CSE'd with
+        get_rows' in the composed train step) and delegates the update
+        to apply_grad_rows — one definition of the push math."""
         if not has_V:
             w = _gather(state.w, slots)
             sg = _gather(state.sqrt_g, slots)
@@ -452,38 +558,8 @@ def make_fns(param: SGDUpdaterParam):
                 w=_scatter(state.w, slots, w_new),
                 sqrt_g=_scatter(state.sqrt_g, slots, sg_new),
                 z=_scatter(state.z, slots, z_new))
-
-        k, h, _, off = _layout(state)
-        rows = _gather(state.VVg, slots)
-        w, z, sg, cnt, live = unpack_scal(rows[:, off:])
-        w_new, z_new, sg_new = _ftrl(w, z, sg, gw)
-        # lazy-V activation on the touched rows (the union of the
-        # reference's two trigger sites re-evaluated after the update)
-        live_new = live | ((w_new != 0) & (cnt > thr))
-        scal = pack_scal(w_new, z_new, sg_new, cnt, live_new,
-                         state.VVg.dtype)
-
-        if gV is not None:
-            V = rows[:, :k].astype(jnp.float32)
-            Vg = rows[:, h:h + k].astype(jnp.float32)
-            gv = gV + V_l2 * V
-            Vg_new = jnp.sqrt(Vg * Vg + gv * gv)
-            V_new = V - V_lr / (Vg_new + V_lr_beta) * gv
-            # AdaGrad only touches rows whose embedding was PULLED this
-            # batch (lens[i] > 1 semantics, sgd_updater.cc:91-96)
-            upd = pull_vmask[:, None] > 0
-            emb = jnp.where(upd, fuse_vvg(V_new, Vg_new, h),
-                            rows[:, :2 * h].astype(jnp.float32)
-                            ).astype(state.VVg.dtype)
-        else:
-            emb = rows[:, :2 * h]
-        # in-pad layout: scal replaces the tail of emb's own pad lanes;
-        # appended layout: the gap lanes between are carried through
-        if off <= 2 * h:
-            out = jnp.concatenate([emb[:, :off], scal], axis=1)
-        else:
-            out = jnp.concatenate([emb, rows[:, 2 * h:off], scal], axis=1)
-        return state._replace(VVg=_scatter(state.VVg, slots, out))
+        rows = pull_rows(state, slots)
+        return apply_grad_rows(state, slots, rows, gw, gV, pull_vmask)
 
     def evaluate(state: SGDState) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """(penalty, nnz) over real rows (Evaluate, sgd_updater.cc:15-32).
@@ -509,4 +585,11 @@ def make_fns(param: SGDUpdaterParam):
     ns.apply_grad = apply_grad
     ns.evaluate = evaluate
     ns.param = param
+    # fused-kernel surface (ops/fused.py; step.py threads rows through
+    # when ``fused`` is set): pull once, update the threaded rows
+    ns.backend = backend
+    ns.fused = backend != "off"
+    ns.pull_rows = pull_rows
+    ns.rows_to_params = rows_to_params
+    ns.apply_grad_rows = apply_grad_rows
     return ns

@@ -199,6 +199,72 @@ def test_corrupt_one_shard_fails_typed_and_walks_back(tmp_path):
     assert store.fs_count == 2
 
 
+def test_two_host_sim_sharded_saves_roundtrip(tmp_path):
+    """Multi-host × fs>1 per-shard saves (PR 12 leftover, ISSUE 13
+    satellite): every rank writes its OWN ``<model>_part-<rank>``
+    sharded family (the table is host-complete — fs stays intra-host,
+    dp replicates it across hosts, parallel/mesh.py), so ANY rank's
+    family restores the full table into any mesh. Simulated with two
+    stores holding the identical dp-replicated state:
+
+    - both ranks' families verify independently (members + stub);
+    - rank 1's family loads byte-identically to rank 0's, into fs=2,
+      fs=4 AND fs=1 (unsharded) stores;
+    - a corrupt shard member in rank 0's family fails typed, and the
+      resume walk order (learners/sgd._try_resume: own rank first,
+      then every rank) lands on rank 1's intact family."""
+    import jax
+
+    from difacto_tpu.utils import manifest as mft
+    mesh = make_mesh(dp=1, fs=2)
+    s0 = _filled_store(mesh)
+    s1 = SlotStore(s0.param, mesh=mesh)
+    # rank 1 holds the same dp-replicated state
+    s1.state = jax.tree_util.tree_map(lambda x: x, s0.state)
+    base = str(tmp_path / "model_iter-0_part-")
+    n0 = s0.save(base + "0", save_aux=True, epoch=0)
+    n1 = s1.save(base + "1", save_aux=True, epoch=0)
+    assert n0 == n1 > 0
+    for rank in (0, 1):
+        for i in range(2):
+            man = mft.verify(fs_shard_path(base + str(rank), i, 2),
+                             require_manifest=True)
+            assert man["fs_count"] == 2 and man["fs_shard"] == i
+        assert mft.verify(base + str(rank),
+                          require_manifest=True)["fs_count"] == 2
+
+    loads = []
+    for fs, m in ((2, mesh), (4, make_mesh(dp=1, fs=4)), (1, None)):
+        fresh = SlotStore(s0.param, mesh=m)
+        assert fresh.load(base + "1", weights_only=False) == n0
+        loads.append(fresh)
+    for a, b, c in zip(_state_cols(s0), _state_cols(loads[0]),
+                       _state_cols(loads[2])):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(a, c)
+
+    # torn rank-0 family: bit-flip one shard member, walk to rank 1
+    sp = fs_shard_path(base + "0", 1, 2)
+    with open(sp, "r+b") as f:
+        data = f.read()
+        f.seek(data.find(b"w.npy") + 150)
+        f.write(b"\xff\xff\xff")
+    resumed = None
+    fresh = SlotStore(s0.param, mesh=mesh)
+    for rank in (0, 1):        # the _try_resume walk order
+        try:
+            fresh.load(base + str(rank), require_manifest=True)
+            resumed = rank
+            break
+        except (FileNotFoundError, OSError):
+            continue
+        except CheckpointCorrupt:
+            continue
+    assert resumed == 1
+    for a, b in zip(_state_cols(s0), _state_cols(fresh)):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_missing_shard_member_is_corrupt(tmp_path):
     mesh = make_mesh(dp=1, fs=2)
     s = _filled_store(mesh)

@@ -981,14 +981,16 @@ def test_the_tree_is_clean(capsys):
     # in obs/trace.start_device), 6 wall-clock (cross-process file
     # timestamps x3, JSONL record stamps, trace-id entropy, run-dir
     # stamp), 2 lock-release (locktrace forwarding wrapper),
-    # 1 lock-blocking (native build serialization), 15 jax-recompile
+    # 1 lock-blocking (native build serialization), 17 jax-recompile
     # (pack/staging-time sticky caps the provenance model cannot chase
-    # through payload tuples / the device cache; warm-replay keys;
-    # probe-tool per-variant compiles; the capacity-scaling sweep's
-    # one-compile-per-fs-rung loop in parallel/capacity.py — the loop
-    # IS the benchmark matrix), 4 jax-host-sync (timing-harness
-    # completion fences in probe tools)
-    assert doc["counts"]["suppressed"] == 50
+    # through payload tuples / the device cache — incl. the ISSUE 13
+    # panel_raw device-dedup dispatch; warm-replay keys; probe-tool
+    # per-variant compiles; the capacity-scaling sweep's
+    # one-compile-per-fs-rung loop in parallel/capacity.py and the
+    # kernel bench's one-compile-per-backend loop in bench.py — those
+    # loops ARE the benchmark matrices), 4 jax-host-sync
+    # (timing-harness completion fences in probe tools)
+    assert doc["counts"]["suppressed"] == 52
 
 
 # ---------------------------------------------------------------------------

@@ -47,7 +47,8 @@ def main() -> None:
     from bench import build_step, make_batches
     from difacto_tpu.ops.batch import panel_chunk_tokens
 
-    step_raw, state = build_step(args.vdim, args.capacity, "bfloat16")
+    step_raw, state = build_step(args.vdim, args.capacity,
+                                 "bfloat16")[:2]
     hb = make_batches(4, args.batch, 39, args.uniq, args.capacity, "zipf")
     u_cap = int(hb[0][1].shape[0])
     chunker = jax.jit(panel_chunk_tokens, static_argnums=(1,))
