@@ -16,8 +16,8 @@ DATA_FORMAT ?= criteo
 DATA_OUT ?= $(basename $(DATA_IN)).rec
 
 .PHONY: test smoke ci lint lint-changed lint-baseline lockmap jitmap \
-	chaos fleet-chaos obs-report convert stream-bench multichip-bench \
-	kernel-parity
+	hlomap chaos fleet-chaos obs-report convert stream-bench \
+	multichip-bench kernel-parity
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -64,6 +64,18 @@ jitmap:
 	$(PY) tools/jitmap.py --json jitmap.json \
 	  $(if $(JAXTRACE),--dynamic $(JAXTRACE))
 
+# merged static+dynamic sharding map (docs/static_analysis.md v5): the
+# shardflow layout-pin verdicts next to a compiled-HLO collective/
+# memory scan of the REAL fs=4 train step + serve executor on the CPU
+# virtual mesh. --check fails on any table-axis all-gather/all-to-all,
+# temp-budget breach, or scan site outside the static model:
+#   make hlomap                            # scan + merge + gate
+#   make hlomap HLOSCAN=run.hlo.json       # merge a DIFACTO_HLOSCAN_OUT dump
+HLOSCAN ?=
+hlomap:
+	$(PY) tools/hlomap.py --json hlomap.json \
+	  $(if $(HLOSCAN),--dynamic $(HLOSCAN),--scan --fs 4) --check
+
 # resilience suite alone (fault injection, drain, blue/green, takeover,
 # client failover — tests/test_chaos.py and friends)
 chaos:
@@ -92,7 +104,7 @@ smoke:
 	__graft_entry__.dryrun_multichip(8); \
 	print('entry + dryrun ok')"
 
-ci: lint test smoke
+ci: lint test hlomap smoke
 
 # human summary of a run's observability artifacts (docs/observability.md):
 #   make obs-report METRICS=run.metrics.jsonl TRACE=run.trace.json

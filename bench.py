@@ -36,7 +36,8 @@ MEASURED_HBM_GBPS = 87.0  # 1GiB stream mul+reduce, this chip via tunnel
 
 
 def build_step(V_dim: int, capacity: int, v_dtype: str,
-               chunks_sorted: bool = True, fused_kernel: str = "auto"):
+               chunks_sorted: bool = True, fused_kernel: str = "auto",
+               mesh=None):
     import dataclasses
 
     from difacto_tpu.losses import create
@@ -47,7 +48,7 @@ def build_step(V_dim: int, capacity: int, v_dtype: str,
     param = SGDUpdaterParam(V_dim=V_dim, V_threshold=0, lr=0.1, l1=1e-4,
                             l2=1e-4, V_dtype=v_dtype,
                             fused_kernel=fused_kernel)
-    fns = make_fns(param)
+    fns = make_fns(param, mesh=mesh)
     loss = create("fm", V_dim)
     if not chunks_sorted:
         loss = dataclasses.replace(loss, chunks_sorted=False)
@@ -56,7 +57,16 @@ def build_step(V_dim: int, capacity: int, v_dtype: str,
         from difacto_tpu.updaters.sgd_updater import set_all_live
         state = set_all_live(param, state)
 
-    _, train_step, _ = make_step_fns(fns, loss)
+    # under a mesh the train step must pin its returned state to the fs
+    # key-range layout (step.state_constrainer) — otherwise GSPMD output
+    # inference is free to re-partition the donated table (the bench
+    # would silently measure an unpinned program the product never runs)
+    state_shardings = None
+    if mesh is not None:
+        from difacto_tpu.parallel import sharding_tree, state_sharding
+        state_shardings = sharding_tree(state, state_sharding(mesh))
+    _, train_step, _ = make_step_fns(fns, loss,
+                                     state_shardings=state_shardings)
     # raw (unjitted) step: the bench jits it with a donated state and
     # dispatches per step, the production replay pattern
     return train_step, state, fns, loss, param
@@ -733,7 +743,8 @@ def main() -> None:
         args.vdim, args.capacity, args.vdtype,
         chunks_sorted=mesh is None or mesh.shape["dp"] == 1,
         fused_kernel=args.fused_kernel if mesh is None else
-        ("jnp" if args.fused_kernel == "pallas" else args.fused_kernel))
+        ("jnp" if args.fused_kernel == "pallas" else args.fused_kernel),
+        mesh=mesh)
     host_batches = make_batches(4, args.batch_size, args.nnz_per_row,
                                 args.uniq, args.capacity, args.dist,
                                 chunk_multiple=(mesh.shape["dp"]

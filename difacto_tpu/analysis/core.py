@@ -90,7 +90,7 @@ def rule(rule_id: str, summary: str, cross: bool = False):
 def all_rules() -> Dict[str, Rule]:
     # import for side effect: the @rule decorators populate RULES
     from . import (concurrency, crossrules, jaxflow,  # noqa: F401
-                   localrules, races)
+                   localrules, races, shardflow)
     return RULES
 
 

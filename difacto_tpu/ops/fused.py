@@ -44,12 +44,15 @@ ops/batch.py contract) and the inverse index map inside the jit step.
 
 from __future__ import annotations
 
+import logging
 from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..utils import jaxtrace
+
+log = logging.getLogger("difacto_tpu")
 
 # rows per pallas grid step: every ShapeSchedule/bucket rung >= 8 is
 # divisible by 4 (ops/batch.bucket — {8*2^j, 12*2^j} rungs), so a tile
@@ -96,7 +99,9 @@ def resolve_backend(knob: str, mesh=None, V_dim: int = 0) -> str:
         raise ValueError(
             f"unknown fused_kernel {knob!r} (expected auto|pallas|jnp|off)")
     if knob == "off" or V_dim == 0:
-        return "off"
+        reason = ("fused_kernel=off" if knob == "off"
+                  else "flat table (V_dim=0) has no fused row")
+        return _log_resolution(knob, "off", reason)
     if knob == "pallas":
         if mesh is not None:
             raise ValueError(
@@ -108,10 +113,25 @@ def resolve_backend(knob: str, mesh=None, V_dim: int = 0) -> str:
             raise ValueError(
                 "fused_kernel=pallas but jax.experimental.pallas is "
                 "not importable in this jax build")
-        return "pallas"
+        return _log_resolution(knob, "pallas",
+                               "interpret mode (parity harness)"
+                               if interpret_mode() else "TPU Mosaic")
     if knob == "jnp":
-        return "jnp"
-    return "jnp"   # auto
+        return _log_resolution(knob, "jnp", "explicit knob")
+    return _log_resolution(
+        knob, "jnp",
+        "auto never picks pallas (docs/perf_notes.md); "
+        + ("mesh run — GSPMD partitions the jnp primitives"
+           if mesh is not None else "measured-fastest backend"))
+
+
+def _log_resolution(knob: str, backend: str, reason: str) -> str:
+    """One INFO line per resolution (i.e. once per learner/store —
+    make_fns resolves once): ``auto`` silently landing on ``jnp`` under
+    a mesh confused the BENCH_r05->r06 comparison, so the resolved
+    backend and why are now in the run log."""
+    log.info("fused_kernel: %s -> %s (%s)", knob, backend, reason)
+    return backend
 
 
 # --------------------------------------------------------------- dedup

@@ -91,6 +91,29 @@ def capacity_scaling_report(fs_values: Optional[Sequence[int]] = None,
                         nnz_cap=batch * nnz_per_row)
         dev = shard_pytree(dev, lambda x: replicated(mesh))
 
+        # layout-cleanliness proof for the MULTICHIP metric: scan the
+        # leg's compiled HLO (utils/hloscan.py) BEFORE the donating
+        # warm call — zero table-axis collectives is what makes the
+        # throughput numbers mean "sharded", not "secretly gathered"
+        from ..utils import hloscan
+        leg_hlo = None
+        try:
+            compiled = step.lower(state, dev, slots).compile()
+            one = hloscan.scan_compiled(compiled, rows=cap,
+                                        label="train_step")
+            hloscan.record(
+                getattr(step, "site", "difacto_tpu/parallel/capacity.py"),
+                compiled, label="train_step", rows=cap)
+            leg_hlo = {
+                "table_collectives": one["table_collectives"],
+                "peak_temp_bytes": one["peak_temp_bytes"],
+            }
+        except Exception as e:   # the sweep must survive a scan failure
+            import logging
+            logging.getLogger("difacto_tpu").warning(
+                "capacity: hlo scan of the fs=%d leg failed: %s", fs, e)
+            leg_hlo = None
+
         state, objv, _ = step(state, dev, slots)           # compile
         jaxtrace.fetch(objv, point="capacity.fence")
         t0 = time.perf_counter()
@@ -99,14 +122,17 @@ def capacity_scaling_report(fs_values: Optional[Sequence[int]] = None,
         jaxtrace.fetch(objv, point="capacity.fence")
         dt = time.perf_counter() - t0
         total = state_bytes(param, cap)
-        legs.append({
+        leg = {
             "fs": fs,
             "hash_capacity": cap,
             "table_bytes_total": int(total),
             "table_bytes_per_device": int(total // fs),
             "examples_per_sec": round(steps * batch / dt, 1),
             "step_ms": round(dt / steps * 1e3, 3),
-        })
+        }
+        if leg_hlo is not None:
+            leg["hlo"] = leg_hlo
+        legs.append(leg)
         del state
     out = {
         "metric": "multichip_capacity_scaling",

@@ -73,7 +73,11 @@ class _SiteStats:
 
 
 def enabled() -> bool:
-    return os.environ.get("DIFACTO_JAXTRACE", "") not in ("", "0")
+    # DIFACTO_HLOSCAN implies tracing: the HLO scan (utils/hloscan.py)
+    # rides the same _TracedJit wrappers and jit-site identities, so
+    # turning it on must install them even without DIFACTO_JAXTRACE
+    return os.environ.get("DIFACTO_JAXTRACE", "") not in ("", "0") \
+        or os.environ.get("DIFACTO_HLOSCAN", "") not in ("", "0")
 
 
 def _site(depth: int = 2) -> str:
@@ -136,6 +140,11 @@ class _TracedJit:
         self._statics = statics
 
     def __call__(self, *args, **kwargs):
+        # hloscan first: lowering only reads avals, so scanning BEFORE
+        # the real dispatch keeps donated buffers untouched
+        from . import hloscan
+        if hloscan.enabled():
+            hloscan.maybe_scan(self.site, self._fn, args, kwargs)
         out = self._fn(*args, **kwargs)
         key = _arg_key(args, kwargs, self._statics)
         try:

@@ -1,0 +1,220 @@
+"""hloscan unit tests + the tier-1 fs=4 HLO gate (difacto-lint v5).
+
+Three layers:
+
+- **scanner units** — the collective classifier over a synthetic HLO
+  dump, the violations view over fabricated program records, and the
+  dump/load round-trip;
+- **a planted failure** — a `P('fs', None)` table jitted with
+  replicated out_shardings MUST produce a table-axis all-gather on the
+  virtual CPU mesh: the scanner is tested against the exact failure it
+  gates;
+- **the gate** — `tools/hlomap.py --scan --fs 4 --check` in a
+  subprocess compiles the REAL fs-sharded train step
+  (parallel/capacity.py) and serve executor (serve/executor.py) and
+  must find zero table-axis collectives, zero budget breaches, and
+  every scanned jit site inside the static shardflow model
+  (dynamic ⊆ static, the same contract as the v2-v4 gates).
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from difacto_tpu.utils import hloscan
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_hlomap():
+    spec = importlib.util.spec_from_file_location(
+        "difacto_hlomap", REPO_ROOT / "tools" / "hlomap.py")
+    hlomap = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(hlomap)
+    return hlomap
+
+
+# ---------------------------------------------------------------------------
+# the collective classifier
+
+
+HLO_TEXT = """\
+ENTRY %main {
+  %p = f32[128,4]{1,0} parameter(0)
+  %ag = f32[512,4]{1,0} all-gather(f32[128,4]{1,0} %p), dimensions={0}
+  %ar = f32[64]{0} all-reduce(f32[64]{0} %g), to_apply=%sum
+  %add = f32[128,4]{1,0} add(%p, %p)
+}
+"""
+
+
+def test_scan_text_classifies_table_axis():
+    colls = hloscan.scan_text(HLO_TEXT, rows=512)
+    assert {c["kind"] for c in colls} == {"all-gather", "all-reduce"}
+    ag = next(c for c in colls if c["kind"] == "all-gather")
+    # the gathered result carries the FULL table row count: table-axis
+    assert ag["table_axis"] and 512 in ag["dims"]
+    # all-reduce combines values, never axes — expected, not a hit
+    ar = next(c for c in colls if c["kind"] == "all-reduce")
+    assert not ar["table_axis"]
+    # rows=0 disables the classification entirely
+    assert all(not c["table_axis"]
+               for c in hloscan.scan_text(HLO_TEXT, rows=0))
+    # a different table size does not match this gather
+    colls = hloscan.scan_text(HLO_TEXT, rows=4096)
+    assert all(not c["table_axis"] for c in colls)
+
+
+def test_violations_view_over_program_records():
+    progs = {
+        "a.py:1": {"label": "x",
+                   "collectives": [{"kind": "all-gather",
+                                    "dims": [128, 512],
+                                    "table_axis": True, "line": ""}],
+                   "table_collectives": 1, "peak_temp_bytes": 10,
+                   "over_budget": False, "signatures": 1},
+        "b.py:2": {"label": "y", "collectives": [],
+                   "table_collectives": 0, "peak_temp_bytes": 999,
+                   "over_budget": True, "signatures": 2},
+        "c.py:3": {"label": "z", "collectives": [],
+                   "table_collectives": 0, "peak_temp_bytes": 1,
+                   "over_budget": False, "signatures": 1},
+    }
+    v = hloscan.violations(progs)
+    assert sorted(x["kind"] for x in v) == ["table-collective",
+                                            "temp-budget"]
+    assert {x["site"] for x in v} == {"a.py:1", "b.py:2"}
+
+
+def test_dump_load_round_trip(tmp_path, monkeypatch):
+    hloscan.reset()
+    monkeypatch.setenv("DIFACTO_HLOSCAN_ROWS", "512")
+    monkeypatch.setenv("DIFACTO_HLOSCAN_BUDGET", "0")
+    path = tmp_path / "scan.json"
+    hloscan.dump(path)
+    doc = hloscan.load(path)
+    assert doc == {"rows": 512, "budget": 0, "programs": {}}
+    # version gate: a foreign dump must be rejected, not misread
+    path.write_text(json.dumps({"version": 99, "programs": {}}))
+    with pytest.raises(ValueError):
+        hloscan.load(path)
+    hloscan.reset()
+
+
+# ---------------------------------------------------------------------------
+# the planted failure: forced replication of an fs-sharded table MUST
+# surface as a table-axis all-gather
+
+
+def test_planted_replication_is_detected():
+    import jax
+    import jax.numpy as jnp
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from difacto_tpu.parallel import make_mesh, replicated, state_sharding
+
+    mesh = make_mesh(dp=1, fs=4)
+    rows = 512
+    table = jnp.zeros((rows, 4), jnp.float32)
+    table = jax.device_put(table, state_sharding(mesh)(table))
+    bad = jax.jit(lambda a: a * 2.0, out_shardings=replicated(mesh))
+    compiled = bad.lower(table).compile()
+    rec = hloscan.scan_compiled(compiled, rows=rows, label="planted")
+    assert rec["table_collectives"] >= 1, rec["collectives"]
+    assert any(c["kind"] == "all-gather" and c["table_axis"]
+               for c in rec["collectives"])
+    # and the registry/violations plumbing agrees
+    hloscan.reset()
+    hloscan.record("planted.py:1", compiled, label="planted", rows=rows)
+    v = hloscan.violations()
+    assert any(x["kind"] == "table-collective"
+               and x["site"] == "planted.py:1" for x in v)
+    hloscan.reset()
+
+
+# ---------------------------------------------------------------------------
+# hlomap --check over recorded dumps (no compile needed)
+
+
+def _write_dump(tmp_path, programs):
+    p = tmp_path / "scan.json"
+    p.write_text(json.dumps({"version": 1, "rows": 512, "budget": 0,
+                             "programs": programs}))
+    return p
+
+
+_CLEAN_REC = {"label": "x", "collectives": [], "table_collectives": 0,
+              "peak_temp_bytes": 1, "over_budget": False,
+              "signatures": 1}
+
+
+def test_hlomap_check_fails_on_planted_violations(tmp_path, capsys):
+    hlomap = _load_hlomap()
+    graph = hlomap.build(REPO_ROOT)
+    good_site = sorted(s for s in graph["sites"] if ":" in s)[0]
+    bad_rec = dict(_CLEAN_REC)
+    bad_rec["collectives"] = [{"kind": "all-gather", "dims": [512],
+                               "table_axis": True, "line": ""}]
+    bad_rec["table_collectives"] = 1
+    dump = _write_dump(tmp_path, {good_site: bad_rec,
+                                  "nowhere.py:1": dict(_CLEAN_REC)})
+    rc = hlomap.main(["--root", str(REPO_ROOT),
+                      "--dynamic", str(dump), "--check"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "TABLE-HITS" in out
+    assert "UNKNOWN-SITES: nowhere.py:1" in out
+
+    merged = hlomap.build(REPO_ROOT, hloscan.load(dump))
+    assert [v["site"] for v in merged["table_hits"]] == [good_site]
+    assert merged["unknown_sites"] == ["nowhere.py:1"]
+
+
+def test_hlomap_check_passes_on_clean_known_sites(tmp_path):
+    hlomap = _load_hlomap()
+    graph = hlomap.build(REPO_ROOT)
+    good_site = sorted(s for s in graph["sites"] if ":" in s)[0]
+    dump = _write_dump(tmp_path, {good_site: dict(_CLEAN_REC),
+                                  "train_step": dict(_CLEAN_REC)})
+    # non-site labels (explicit record() keys, e.g. capacity legs) are
+    # exempt from the dynamic ⊆ static subset claim
+    rc = hlomap.main(["--root", str(REPO_ROOT),
+                      "--dynamic", str(dump), "--check"])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: compile the REAL fs=4 train step + serve executor
+# and prove layout cleanliness end to end
+
+
+def test_fs4_hlo_gate_train_and_serve(tmp_path):
+    out = tmp_path / "hlomap.json"
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "hlomap.py"),
+         "--scan", "--fs", "4", "--rows", "1024",
+         "--json", str(out), "--check"],
+        cwd=str(REPO_ROOT), env=env, capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(out.read_text())
+    scanned = set(doc["programs"])
+    assert any("parallel/capacity.py" in s for s in scanned), scanned
+    assert any("serve/executor.py" in s for s in scanned), scanned
+    # zero table-axis collectives, zero budget breaches, and every
+    # scanned jit site known to the static model: dynamic ⊆ static
+    assert doc["table_hits"] == []
+    assert doc["budget_hits"] == []
+    assert doc["unknown_sites"] == []
+    assert {s for s in scanned if ":" in s} <= set(doc["sites"])
+    # the fs-scoped state programs all carry pin evidence
+    for sid, rec in doc["state_programs"].items():
+        assert rec["pinned"], (sid, rec)
