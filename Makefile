@@ -67,14 +67,16 @@ jitmap:
 # merged static+dynamic sharding map (docs/static_analysis.md v5): the
 # shardflow layout-pin verdicts next to a compiled-HLO collective/
 # memory scan of the REAL fs=4 train step + serve executor on the CPU
-# virtual mesh. --check fails on any table-axis all-gather/all-to-all,
-# temp-budget breach, or scan site outside the static model:
+# virtual mesh, plus a bounded-delay leg (--tau 4) driving the windowed
+# fs=4 train step through the 2+τ pipeline. --check fails on any
+# table-axis all-gather/all-to-all, temp-budget breach, or scan site
+# outside the static model:
 #   make hlomap                            # scan + merge + gate
 #   make hlomap HLOSCAN=run.hlo.json       # merge a DIFACTO_HLOSCAN_OUT dump
 HLOSCAN ?=
 hlomap:
 	$(PY) tools/hlomap.py --json hlomap.json \
-	  $(if $(HLOSCAN),--dynamic $(HLOSCAN),--scan --fs 4) --check
+	  $(if $(HLOSCAN),--dynamic $(HLOSCAN),--scan --fs 4 --tau 4) --check
 
 # resilience suite alone (fault injection, drain, blue/green, takeover,
 # client failover — tests/test_chaos.py and friends)

@@ -638,14 +638,30 @@ def run_multichip(args) -> dict:
     ~constant per-device bytes while ex/s reports the collective cost.
     The driver's MULTICHIP_r*.json gets the same metric from
     __graft_entry__.dryrun_multichip (small shapes); this leg is the
-    full-size version for by-hand runs on the 8-chip box."""
-    from difacto_tpu.parallel.capacity import capacity_scaling_report
+    full-size version for by-hand runs on the 8-chip box.
 
-    return capacity_scaling_report(
+    The ``delay`` block rides along: bounded-delay (τ) pipelining legs
+    at hosts x {1,2,4} simulated straggler timelines x τ (--delay-taus,
+    default {0,1,4}) over the same fused fs-sharded step — {hosts, tau,
+    ex/s} plus the delay-vs-AUC trajectory leg (auc_delta vs τ=0), each
+    leg carrying its compiled hlo.{table_collectives, peak_temp_bytes}
+    scan (difacto_tpu/parallel/capacity.bounded_delay_report)."""
+    from difacto_tpu.parallel.capacity import (bounded_delay_report,
+                                               capacity_scaling_report)
+
+    rep = capacity_scaling_report(
         base_capacity=args.multichip_capacity,
         V_dim=args.vdim, batch=args.batch_size,
         nnz_per_row=args.nnz_per_row, steps=args.steps,
         v_dtype=args.vdtype)
+    rep["delay"] = bounded_delay_report(
+        hosts_values=(1, 2, 4),
+        taus=tuple(int(t) for t in args.delay_taus.split(",")),
+        base_capacity=args.multichip_capacity,
+        V_dim=args.vdim, batch=args.batch_size,
+        nnz_per_row=args.nnz_per_row, steps=max(args.steps, 6),
+        v_dtype=args.vdtype)
+    return rep
 
 
 def main() -> None:
@@ -681,6 +697,10 @@ def main() -> None:
                            "table of --multichip-capacity * fs rows per "
                            "fs rung in {1,2,4,8}, ex/s + per-device "
                            "bytes per leg")
+    ap.add_argument("--delay-taus", default="0,1,4",
+                    help="comma-separated bounded-delay windows for the "
+                         "--multichip delay legs (τ batches of permitted "
+                         "staleness; 0 = synchronous)")
     ap.add_argument("--multichip-capacity", type=int, default=1 << 20,
                     help="per-fs-rung base hash_capacity of the "
                          "--multichip sweep (table = base * fs rows)")

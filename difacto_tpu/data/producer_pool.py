@@ -649,7 +649,7 @@ class ProcessProducerPool:
             self._worker_part[w] = None
             if wp is not None:
                 part, _ = wp
-                self.pool.reset(w)
+                self.pool.reissue_dead(w)
                 gen[part] += 1  # invalidate its still-queued deliveries
         if not any_alive and not self._finished:
             alive_assignments = [wp for wp in self._worker_part if wp]

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -315,6 +316,22 @@ class SGDLearnerParam(Param):
     # and bucket per batch.
     nnz_cap: int = 0
     uniq_cap: int = 0
+    # bounded-delay asynchronous training (the reference's max_delay τ,
+    # SURVEY §5.7/§5.8): the control-plane exchange pipeline may run up
+    # to τ steps AHEAD of the slowest peer's dispatched step before
+    # blocking on its clock (multihost.post_clock/wait_clock). τ=0 is
+    # the fully synchronous schedule — BYTE-IDENTICAL to the pre-window
+    # code path (prefetch depth 2, no clock traffic); τ>0 deepens the
+    # exchange window to 2+τ staged steps so a fast host overlaps its
+    # pull->step->push pipeline with slow hosts' DCN exchanges. The
+    # trajectory itself is τ-invariant: device steps stay collective-
+    # synchronous on the global mesh (XLA collectives cannot lose a
+    # member), so τ buys throughput, not a quality delta
+    # (docs/perf_notes.md "Bounded-delay training"). -1 (default)
+    # inherits DIFACTO_BOUNDED_DELAY from the launcher env (launch.py
+    # --bounded-delay), else 0. τ>0 with a mesh also engages the
+    # windowed SPMD schedule on a single host (its fast path).
+    bounded_delay: int = -1
     # observability (difacto_tpu/obs): append a JSONL snapshot of the
     # run's metric registry to this path every metrics_interval_s (plus a
     # final flush at run end); "" disables. tools/obs_report.py renders
@@ -419,25 +436,39 @@ class SGDLearner(Learner):
         # dist_tracker.h:164-186). Enabled by launch.py via DIFACTO_HB_*.
         from ..parallel import fault
         self.monitor = fault.from_env(self._host_rank, self._num_hosts)
+        # bounded-delay window: explicit knob wins, else the launcher's
+        # cluster-wide env (launch.py --bounded-delay), else synchronous
+        self._tau = (self.param.bounded_delay
+                     if self.param.bounded_delay >= 0
+                     else int(os.environ.get("DIFACTO_BOUNDED_DELAY",
+                                             "0")))
+        # the synchronized/windowed SPMD schedule engages for any
+        # multi-host mesh run, and on a single host when a τ>0 window is
+        # requested (the windowed fast path: same schedule, clock posts
+        # take their single-process early returns)
+        self._spmd_schedule = self.mesh is not None and (
+            self._num_hosts > 1 or self._tau > 0)
         if self._num_hosts > 1:
-            if self.mesh is not None:
-                # synchronized-step SPMD over a global mesh: every host
-                # executes the same jitted step each iteration with a
-                # pre-agreed shape schedule (_iterate_data_spmd); per-host
-                # batch-count divergence is absorbed by empty padded
-                # batches, uniq divergence by a slot-union allgather.
-                if self.param.mesh_dp % self._num_hosts:
-                    raise ValueError(
-                        f"mesh_dp={self.param.mesh_dp} must be a multiple "
-                        f"of the host count {self._num_hosts}")
-                # dp-sharded dims must divide the dp axis (see dim_min in
-                # _iterate_data)
-                from ..ops.batch import mesh_dim_min
-                dmin = mesh_dim_min(self.param.mesh_dp)
-                auto = bucket(self.param.batch_size * 64, dmin)
-                self._spmd_b_cap = bucket(self.param.batch_size, dmin)
-                self._spmd_nnz_cap = self.param.nnz_cap or auto
-                self._spmd_u_cap = self.param.uniq_cap or auto
+            if self.mesh is not None and self.param.mesh_dp \
+                    % self._num_hosts:
+                raise ValueError(
+                    f"mesh_dp={self.param.mesh_dp} must be a multiple "
+                    f"of the host count {self._num_hosts}")
+        if self._spmd_schedule:
+            # synchronized-step SPMD over a global mesh: every host
+            # executes the same jitted step each iteration with a
+            # pre-agreed shape schedule (_iterate_data_spmd); per-host
+            # batch-count divergence is absorbed by empty padded
+            # batches, uniq divergence by a slot-union allgather.
+            # dp-sharded dims must divide the dp axis (see dim_min in
+            # _iterate_data)
+            from ..ops.batch import mesh_dim_min
+            dmin = mesh_dim_min(self.param.mesh_dp)
+            auto = bucket(self.param.batch_size * 64, dmin)
+            self._spmd_b_cap = bucket(self.param.batch_size, dmin)
+            self._spmd_nnz_cap = self.param.nnz_cap or auto
+            self._spmd_u_cap = self.param.uniq_cap or auto
+        if self._num_hosts > 1:
             # Both store modes work over a multi-host MESH. Hashed: slot
             # assignment is stateless modular hashing, identical on every
             # host for free. Dictionary (exact 64-bit ids, the reference's
@@ -852,7 +883,7 @@ class SGDLearner(Learner):
                         prog: Progress) -> None:
         p = self.param
         n_jobs = p.num_jobs_per_epoch if job_type == K_TRAINING else 1
-        if self._num_hosts > 1 and self.mesh is not None:
+        if self._spmd_schedule:
             cache = self._get_cache(job_type)
             cached_parts: set = set()
             if cache is not None and cache.ready:
@@ -979,10 +1010,25 @@ class SGDLearner(Learner):
            arrays dp-sharded from per-host blocks, slot union replicated.
         The epoch ends when no host has data, so all hosts issue the same
         number of collective-bearing programs (no SPMD deadlock).
+
+        **Bounded delay** (τ = ``bounded_delay``, the reference's
+        ``max_delay``): with τ=0 this function IS the synchronous
+        schedule above — no clock machinery runs and the trajectory is
+        byte-identical to the pre-τ code path. With τ>0 the exchange
+        pipeline below runs up to ``2+τ`` steps ahead of the device
+        dispatch, and a clock-vector barrier bounds the skew: each host
+        posts a clock key after dispatching step t (post_clock) and the
+        exchange thread, before staging step s, blocks until every peer
+        has dispatched step ``s-τ-1`` (wait_clock). Fast hosts overlap
+        their pull→step→push pipeline with slow hosts' DCN exchanges up
+        to the window; because waits are on strictly earlier peer steps
+        the protocol is deadlock-free, and because device steps remain
+        collective-synchronous on the global mesh the MODEL trajectory
+        is τ-invariant — τ only moves wait time off the critical path.
         """
         from ..parallel import put_dp_local, put_global, replicated
-        from ..parallel.multihost import control_allgather_np, \
-            control_cleanup
+        from ..parallel.multihost import clock_open, control_allgather_np, \
+            control_cleanup, post_clock, wait_clock
 
         p = self.param
         cache = self._get_cache(job_type)
@@ -993,6 +1039,33 @@ class SGDLearner(Learner):
         reader = self._make_reader(job_type, epoch, g_idx, g_num)
         b_cap, nnz_cap = self._spmd_b_cap, self._spmd_nnz_cap
         u_cap = self._spmd_u_cap
+        tau = self._tau
+        # windowed-mode state (all untouched when τ=0, keeping that path
+        # byte-identical): a fresh clock generation per part — every host
+        # opens generations in the same deterministic order, so the ids
+        # agree with no communication — and shared step counters between
+        # the exchange thread (sent) and the dispatch loop (done).
+        # list-cell counters: int append/item assignment is atomic under
+        # the GIL, and each cell has a single writer.
+        clock_gen = clock_open() if tau > 0 else -1
+        sent = [0]   # steps the exchange thread has staged (yielded)
+        done = [0]   # steps the dispatch loop has issued to the device
+        if tau > 0:
+            from ..obs import counter, gauge, histogram
+            stale_g = gauge(
+                "train_staleness_batches",
+                "bounded-delay pipeline skew: staged-ahead batches not "
+                "yet dispatched on this host").labels(
+                    rank=str(self._host_rank))
+            wait_c = counter(
+                "exchange_wait_seconds_total",
+                "seconds the windowed exchange thread spent blocked on "
+                "peer clocks (τ-window full)")
+            delay_h = histogram(
+                "push_delay_batches",
+                "batches of delay between staging a step and posting "
+                "its clock (bounded above by τ + pipeline depth)",
+                bounds=(0, 1, 2, 4, 8, 16, 32))
 
         def produce():
             for blk in reader:
@@ -1055,6 +1128,28 @@ class SGDLearner(Learner):
                             or (job_type == K_TRAINING
                                 and p.neg_sampling != 1)))
             while True:
+                if tau > 0:
+                    # τ-window barrier: before staging step s, every peer
+                    # must have DISPATCHED step s-τ-1 (its clock key is
+                    # posted after dispatch, see the main loop below).
+                    # Each wait targets a strictly earlier peer step, so
+                    # the pairwise blocking can never cycle (deadlock-
+                    # free); within the window the waits return
+                    # instantly and the DCN exchange overlaps the peers'
+                    # device steps.
+                    need = sent[0] - tau - 1
+                    if need >= 0:
+                        waited = 0.0
+                        for r in range(self._num_hosts):
+                            if r == self._host_rank:
+                                continue
+                            if self.monitor is not None:
+                                waited += self.monitor.guarded(
+                                    wait_clock, clock_gen, r, need)
+                            else:
+                                waited += wait_clock(clock_gen, r, need)
+                        if waited:
+                            wait_c.inc(waited)
                 item = next(it, None)
                 # [keys(u) | counts(u) if push_cnt | nu | fmax | nrows |
                 # has] — the counts half is only shipped on the epoch-0
@@ -1276,11 +1371,15 @@ class SGDLearner(Learner):
                         num_uniq=put_global(np.int32(gu),
                                             replicated(self.mesh)),
                     )
+                sent[0] += 1
                 yield batch, slots_dev, cts_dev, nrows_g, cblk, grow
 
         pending: list = []
+        # τ deepens the staging pipeline: the exchange thread may run up
+        # to 2+τ steps ahead of the dispatch loop (τ=0 keeps the historic
+        # depth-2 double-buffer, so that path is untouched)
         for batch, slots_dev, cts_dev, nrows_g, cblk, grow in prefetch(
-                exchange(), depth=2):
+                exchange(), depth=2 + tau):
             if grow is not None:
                 # deferred dictionary growth (see exchange()): applied in
                 # step order on this thread, BEFORE the first step whose
@@ -1331,6 +1430,17 @@ class SGDLearner(Learner):
                           self._payload_nbytes((batch, slots_dev)),
                           capacity=self.store.state.capacity)
             pending.append((nrows_g, objv, auc))
+            if tau > 0:
+                # step done[0] is now in flight on the device — publish
+                # this host's clock so peers' windows can advance, and
+                # account the pipeline skew (staged-ahead minus
+                # dispatched = how many batches of delay the window is
+                # currently absorbing)
+                done[0] += 1
+                post_clock(clock_gen, done[0] - 1)
+                ahead = sent[0] - done[0]
+                stale_g.set(float(ahead))
+                delay_h.observe(float(ahead))
 
         # draining the pending step results blocks on device programs that
         # contain cross-host collectives — keep the dead-host watchdog armed

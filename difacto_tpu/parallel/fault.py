@@ -47,6 +47,20 @@ log = logging.getLogger("difacto_tpu")
 EXIT_PEER_DEAD = 42  # process exit code for "aborted because a peer died"
 
 
+def restart_attempt() -> int:
+    """The launcher's recovery-attempt counter (DIFACTO_RESTART, set by
+    launch.py; 0 on the first launch). The bounded-delay clock keys
+    (multihost.post_clock/wait_clock) are namespaced by it so a
+    relaunched cluster REJOINS AT THE CURRENT CLOCK: every survivor and
+    the evicted host's replacement restart in the same fresh clock
+    epoch, and stale clock keys a dead attempt left in a lingering
+    coordinator can never satisfy a new attempt's window waits."""
+    try:
+        return int(os.environ.get("DIFACTO_RESTART", "0"))
+    except ValueError:
+        return 0
+
+
 def exit_code_for(dead: List[int]) -> int:
     """Exit code that also TELLS the launcher which peer died, so it can
     evict the right host: 100 + min(dead_rank) for ranks < 28 (codes

@@ -142,6 +142,79 @@ def control_allgather_np(arr) -> "np.ndarray":
     return out
 
 
+def _fire_push_stale() -> None:
+    """Chaos-harness injection point ``push.stale``: traversed when a
+    host PUBLISHES its step clock under a bounded-delay (τ>0) window —
+    the moment a delayed gradient push becomes visible to peers that may
+    already be up to τ steps ahead. Fired BEFORE the single-process
+    early return so chaos tests exercise the stale-push path without a
+    cluster; fires count into ``faults_fired_total{point,kind}``."""
+    from ..utils import faultinject
+    faultinject.act_default(faultinject.fire("push.stale"))
+
+
+# Bounded-delay (τ) step clocks for the windowed exchange
+# (learners/sgd.py _iterate_data_spmd). Each host POSTS its clock after
+# dispatching step t (non-blocking KV set); a host whose exchange
+# pipeline would exceed the τ-window blocks on the SPECIFIC peer clock
+# key it needs (present => the get returns immediately, else it blocks
+# until the peer posts) — a pairwise wait, not a symmetric collective,
+# so hosts need not agree on how many waits each issues and the
+# protocol is deadlock-free (every wait targets a strictly earlier
+# step). Keys are namespaced by the launcher's restart attempt
+# (fault.restart_attempt): a relaunched cluster rejoins at a fresh
+# clock epoch consistent across all survivors, never observing the
+# previous attempt's stale clocks. Clock keys ride ``_ctrl_written``
+# and are reclaimed by :func:`control_cleanup` at the part drain.
+
+_clock_gen = 0
+
+
+def clock_open() -> int:
+    """New clock generation for one windowed part. Every host opens
+    generations in the same order (the part loop is the same program),
+    so the returned ids agree across hosts without communication."""
+    global _clock_gen
+    _clock_gen += 1
+    return _clock_gen
+
+
+def post_clock(gen: int, t: int) -> None:
+    """Publish "this host has dispatched windowed step ``t``" (steps
+    number from 0 within generation ``gen``). Non-blocking."""
+    import jax
+    _fire_push_stale()
+    if jax.process_count() == 1:
+        return
+    from .fault import restart_attempt
+    from jax._src import distributed
+    client = distributed.global_state.client
+    key = (f"difacto/clock/{restart_attempt()}/{gen}/"
+           f"{jax.process_index()}/{t}")
+    client.key_value_set_bytes(key, b"1")
+    _ctrl_written.append(key)
+
+
+def wait_clock(gen: int, peer: int, t: int) -> float:
+    """Block until ``peer`` has posted windowed step ``t`` of generation
+    ``gen``; returns the seconds spent blocked (0.0 when the clock was
+    already posted, and always on a single process). Callers route this
+    through the dead-host monitor (``monitor.guarded``) so a peer dying
+    mid-wait aborts for restart instead of hanging to the timeout."""
+    import time as _time
+
+    import jax
+    if jax.process_count() == 1:
+        return 0.0
+    from .fault import restart_attempt
+    from jax._src import distributed
+    client = distributed.global_state.client
+    key = f"difacto/clock/{restart_attempt()}/{gen}/{peer}/{t}"
+    t0 = _time.monotonic()
+    client.blocking_key_value_get_bytes(key, _CTRL_TIMEOUT_MS)
+    return _time.monotonic() - t0
+
+
 def control_cleanup() -> None:
     """Delete this process's control keys once every peer has consumed
     them. Call at a quiesce point all hosts reach together (the part

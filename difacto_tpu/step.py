@@ -19,6 +19,16 @@ O(B) histogram AUC — the sort-based exact AUC costs ~10 ms at 64k batches,
 ~12% of the step; "exact" restores the argsort; "none" skips it. Validation
 always uses the exact metric (early stopping compares val-AUC deltas,
 sgd_learner.cc:92-110).
+
+**Bounded-delay contract** (``bounded_delay``/τ, learners/sgd.py): the
+windowed schedule delays the HOST pipeline only — staging, the DCN
+control exchange and the clock barrier all move off the device critical
+path, while every gradient application still happens inside this fused
+pull→step→push program against the state the previous step returned.
+Delayed gradients therefore never bypass the kernel: there is no
+host-side apply path, no second writer to the donated table, and τ>0
+reuses these exact programs unchanged (the reference applies τ-stale
+gradients server-side the same single-writer way, bounded by max_delay).
 """
 
 from __future__ import annotations

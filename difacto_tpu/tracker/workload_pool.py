@@ -95,6 +95,26 @@ class WorkloadPool:
         """Node died: its in-flight parts go back to the pool."""
         self._set(node, done=False)
 
+    def reissue_dead(self, node: int) -> List[int]:
+        """``reset`` for a node declared DEAD (killed worker process,
+        heartbeat-evicted host): re-queue its in-flight parts, count
+        them into ``tracker_parts_reissued_total{reason="dead"}`` and
+        return the re-queued part ids. The re-queue itself never blocks
+        — survivors pick the parts up from their own dispatch loops, so
+        a bounded-delay (τ) window keeps draining while the eviction is
+        handled (the reference's WorkloadPool::Reset part
+        re-advertisement, workload_pool.h:88-105)."""
+        with self._mu:
+            requeued = [a.part for a in self._assigned if a.node == node]
+        self._set(node, done=False)
+        if requeued:
+            from ..obs import counter
+            counter("tracker_parts_reissued_total",
+                    "workload parts re-queued after a node death or "
+                    "straggler eviction").labels(reason="dead").inc(
+                        len(requeued))
+        return requeued
+
     def _set(self, node: int, done: bool) -> None:
         with self._mu:
             rest = []
@@ -154,4 +174,10 @@ class WorkloadPool:
                 else:
                     rest.append(a)
             self._assigned = rest
-            return requeued
+        if requeued:
+            from ..obs import counter
+            counter("tracker_parts_reissued_total",
+                    "workload parts re-queued after a node death or "
+                    "straggler eviction").labels(reason="straggler").inc(
+                        len(requeued))
+        return requeued

@@ -29,7 +29,12 @@ run of a real cluster) arm through one environment variable:
   serving), ``rec.read`` (every rec2 data-cache member open,
   data/rec2.py — ``err`` is a failed disk read, ``truncate`` reads a
   half-length view which the per-section CRCs must reject as a typed
-  ``RecCorrupt``, never a crash or silent short read).
+  ``RecCorrupt``, never a crash or silent short read), ``push.stale``
+  (a bounded-delay host posting its per-step clock after a windowed
+  push, parallel/multihost.py post_clock — ``err`` models a host
+  failing mid-τ-window while peers may be staged ahead against its
+  clock; the typed failure must surface through the windowed exchange
+  pipeline, not wedge it).
 - ``kind`` — what happens when the fault fires:
     - ``err``      raise :class:`FaultInjected` (an OSError, so IO call
                    sites treat it exactly like a real IO failure);

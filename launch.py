@@ -130,6 +130,10 @@ def _rank_env(rank, n, hosts, port, attempt, args) -> dict:
         "DIFACTO_RANK": str(rank),
         "DIFACTO_RESTART": str(attempt),
     }
+    if args.bounded_delay >= 0:
+        # cluster-wide τ: every worker reads DIFACTO_BOUNDED_DELAY when
+        # the trained config leaves bounded_delay unset (-1)
+        env["DIFACTO_BOUNDED_DELAY"] = str(args.bounded_delay)
     if args.max_restarts > 0:
         env.update(
             DIFACTO_HB_PORT=str(args.hb_port + 64 * attempt),
@@ -302,6 +306,8 @@ def _run_shim_ranked(args, cmd, rdv: str, rank: int, hostname: str) -> int:
         "DIFACTO_HB_TIMEOUT": str(args.hb_timeout),
         "DIFACTO_HB_PEERS": ",".join(hosts),
     })
+    if args.bounded_delay >= 0:
+        env["DIFACTO_BOUNDED_DELAY"] = str(args.bounded_delay)
     return subprocess.call(cmd, env=env)
 
 
@@ -314,7 +320,8 @@ def _shim_cmd(args, cmd, rank_expr=None) -> str:
             "-n", str(args.num_processes),
             "--rendezvous-timeout", str(args.rendezvous_timeout),
             "--hb-port", str(args.hb_port),
-            "--hb-timeout", str(args.hb_timeout)]
+            "--hb-timeout", str(args.hb_timeout),
+            "--bounded-delay", str(args.bounded_delay)]
     line = " ".join(shlex.quote(c) for c in base)
     if rank_expr is not None:
         line += f" --rank {rank_expr}"
@@ -421,6 +428,7 @@ def main() -> int:
         sp.add_argument("--rendezvous-timeout", type=float, default=300.0)
         sp.add_argument("--hb-port", type=int, default=29800)
         sp.add_argument("--hb-timeout", type=float, default=5.0)
+        sp.add_argument("--bounded-delay", type=int, default=-1)
         sp.add_argument("cmd", nargs=argparse.REMAINDER)
         sa = sp.parse_args(sys.argv[2:])
         scmd = sa.cmd[1:] if sa.cmd and sa.cmd[0] == "--" else sa.cmd
@@ -476,6 +484,12 @@ def main() -> int:
                          "one host, relaunch survivors, resume from the "
                          "last checkpoint (needs ckpt_interval + "
                          "auto_resume in the trained config)")
+    ap.add_argument("--bounded-delay", type=int, default=-1,
+                    help="τ: batches of bounded-delay staleness the "
+                         "windowed SPMD exchange may pipeline ahead "
+                         "(exported as DIFACTO_BOUNDED_DELAY to every "
+                         "rank; 0 = fully synchronous, -1 = leave the "
+                         "trained config's bounded_delay in charge)")
     ap.add_argument("--hb-port", type=int, default=29800,
                     help="UDP heartbeat base port (rank i binds base+i)")
     ap.add_argument("--hb-timeout", type=float, default=5.0,

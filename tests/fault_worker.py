@@ -13,6 +13,14 @@ execution regimes:
   first REPLAYED step (epochs 1+ run from the device cache with no DCN
   handshakes at all). The survivor blocks inside the collective-bearing
   jitted step; the replay-wide watchdog guard must abort it.
+- ``window`` (bounded-delay τ>0, ISSUE 16): trains at batch_size=10 so
+  every epoch runs 5 windowed steps per host, and rank 1 dies at its
+  7th clock post — MID-WINDOW in epoch 1, while the survivor's exchange
+  pipeline may be up to τ steps ahead and its wait_clock barriers
+  target rank 1's now-never-coming clock keys. The guarded waits /
+  collectives must abort via the heartbeat watchdog, and the relaunched
+  single process REJOINS AT THE CURRENT CLOCK (fault.restart_attempt
+  namespaces the clock keys) and finishes the run windowed.
 
 Either way the launcher evicts a host and relaunches a single process
 that auto-resumes from the epoch-0 checkpoint and finishes the run over
@@ -63,13 +71,28 @@ if rank == 1 and attempt == "0" and mode == "allgather":
 
     mh.control_allgather_np = _dying_allgather
 
+if rank == 1 and attempt == "0" and mode == "window":
+    import difacto_tpu.parallel.multihost as mh
+    _orig_post, _posts = mh.post_clock, {"n": 0}
+
+    def _dying_post(gen, t):
+        _posts["n"] += 1
+        if _posts["n"] == 7:  # 5 steps/epoch: the 2nd step of epoch 1,
+            _die()            # mid-τ-window after the epoch-0 ckpt
+        return _orig_post(gen, t)
+
+    mh.post_clock = _dying_post
+
 from difacto_tpu.learners import Learner  # noqa: E402
 
 nprocs = jax.process_count()
 ln = Learner.create("sgd")
 ln.init([("data_in", data), ("V_dim", "2"), ("V_threshold", "2"),
          ("lr", "0.1"), ("l1", "0.1"), ("l2", "0"),
-         ("batch_size", "100"), ("max_num_epochs", str(epochs)),
+         # window mode: 5 windowed steps per host per epoch, so the
+         # τ=2 wait_clock barriers genuinely engage before the kill
+         ("batch_size", "10" if mode == "window" else "100"),
+         ("max_num_epochs", str(epochs)),
          ("shuffle", "0"), ("report_interval", "0"),
          ("stop_rel_objv", "0"), ("stop_val_auc", "-2"),
          ("num_jobs_per_epoch", "1"),

@@ -1274,3 +1274,37 @@ def test_ckpt_keep_prunes_whole_family(ckpt_model, rcv1_path, tmp_path):
     # newer generations keep every rank's parts
     assert os.path.exists(f"{model}_iter-2_part-0")
     assert os.path.exists(f"{model}_iter-2_part-1")
+
+
+# ------------------------------ bounded-delay window (ISSUE 16 satellite)
+
+def test_push_stale_fault_fires_typed(rcv1_path, tmp_path):
+    """``push.stale`` (parallel/multihost.post_clock): the stale-push
+    publication point of the bounded-delay window — fired BEFORE the
+    single-process early return, so the chaos harness exercises a τ>0
+    windowed run without a cluster. The injected error surfaces as the
+    typed FaultInjected out of the learner, and both observability
+    surfaces saw it: faultinject.stats() and
+    faults_fired_total{point,kind}."""
+    from difacto_tpu.learners import Learner
+    from difacto_tpu.obs import REGISTRY
+    from difacto_tpu.utils.faultinject import FaultInjected
+
+    before = REGISTRY.value("faults_fired_total", point="push.stale",
+                            kind="err")
+    faultinject.configure("push.stale:err@1")
+    ln = Learner.create("sgd")
+    ln.init([("data_in", rcv1_path), ("V_dim", "0"), ("l2", "1"),
+             ("l1", "0"), ("lr", "1"), ("num_jobs_per_epoch", "1"),
+             ("batch_size", "100"), ("max_num_epochs", "1"),
+             ("shuffle", "0"), ("report_interval", "0"),
+             ("hash_capacity", "1024"), ("nnz_cap", "16384"),
+             ("mesh_dp", "2"), ("mesh_fs", "4"),
+             ("bounded_delay", "1")])
+    with deadline(120):
+        with pytest.raises(FaultInjected):
+            ln.run()
+    assert faultinject.stats().get("push.stale", 0) > 0, \
+        "fault never fired — the windowed schedule never posted a clock"
+    assert REGISTRY.value("faults_fired_total", point="push.stale",
+                          kind="err") > before

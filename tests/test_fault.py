@@ -65,6 +65,46 @@ def test_kill_one_host_mid_epoch_recovers(rcv1_path, tmp_path, mode, port):
     assert "attempt 0 failed" in proc.stderr
 
 
+@two_process_launch
+def test_kill_one_host_mid_window_recovers(rcv1_path, tmp_path):
+    """Bounded-delay chaos arm (ISSUE 16): rank 1 is SIGKILLed
+    MID-WINDOW under τ=2 (launch.py --bounded-delay 2, the cluster-env
+    plumbing) while the survivor's exchange pipeline may be staged
+    ahead. The survivor's guarded wait_clock/allgather must abort via
+    the heartbeat watchdog instead of waiting out the 10-minute KV
+    timeout on the dead host's clock key; the launcher evicts + re-
+    launches; byte-range re-sharding re-issues the dead host's parts;
+    and the relaunched process rejoins at a FRESH clock epoch
+    (fault.restart_attempt namespacing) and finishes the run windowed,
+    resuming from the epoch-0 checkpoint."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker sets its own 4-device flag
+    env["PYTHONPATH"] = str(REPO)
+    env["DIFACTO_HB_TIMEOUT"] = "2"  # overridden timeout: fast test
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "launch.py"), "-n", "2",
+         "--port", "7961", "--max-restarts", "1",
+         "--bounded-delay", "2",
+         "--hb-port", "29940", "--hb-timeout", "2", "--",
+         sys.executable, str(REPO / "tests" / "fault_worker.py"),
+         str(tmp_path), rcv1_path, str(EPOCHS), "window"],
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=540)
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\n" \
+                                 f"stderr:\n{proc.stderr}"
+    with open(tmp_path / "traj-0.json") as f:
+        traj = json.load(f)
+    assert traj["attempt"] == 1
+    assert traj["nprocs"] == 1
+    epochs_run = [e for e, _ in traj["epochs"]]
+    assert epochs_run == list(range(1, EPOCHS))
+    losses = [l for _, l in traj["epochs"]]
+    assert losses[-1] < losses[0]
+    assert "attempt 0 failed" in proc.stderr
+    # the final model was written by the windowed relaunch
+    assert (tmp_path / "model_part-0").exists()
+
+
 def test_heartbeat_detects_dead_peer():
     from difacto_tpu.parallel.fault import (HeartbeatMonitor, HostFailure)
     a = HeartbeatMonitor(0, 2, 29960, interval=0.1, timeout=0.8)

@@ -137,14 +137,16 @@ def test_pjit_same_site_identity_and_counts(monkeypatch):
 
 
 def test_pjit_site_in_static_model(repo_model):
-    """The capacity sweep's jaxtrace.pjit creation site
-    (parallel/capacity.py) is discovered by the static model under the
-    same identity scheme and is warm-declared (reasoned suppression —
-    one compile per fs rung)."""
+    """The capacity bench's jaxtrace.pjit creation sites (the fs
+    capacity sweep + the bounded-delay sweep, parallel/capacity.py)
+    are discovered by the static model under the same identity scheme
+    and are warm-declared (reasoned suppressions — one compile per fs
+    rung / one per delay sweep)."""
     cap_sites = [s for s in repo_model.sites
                  if s.startswith("difacto_tpu/parallel/capacity.py:")]
-    assert len(cap_sites) == 1, cap_sites
-    assert cap_sites[0] in repo_model.known_warm()
+    assert len(cap_sites) == 2, cap_sites
+    for site in cap_sites:
+        assert site in repo_model.known_warm(), site
     # its declared fetch point is known too
     assert any(s.startswith("difacto_tpu/parallel/capacity.py:")
                for s in repo_model.declared_fetches())

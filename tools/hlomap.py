@@ -50,7 +50,8 @@ from difacto_tpu.analysis.shardflow import get_shard_model  # noqa: E402
 from difacto_tpu.utils import hloscan  # noqa: E402
 
 
-def drive_scan(fs: int, capacity: int, budget: int) -> dict:
+def drive_scan(fs: int, capacity: int, budget: int,
+               tau: int = 0) -> dict:
     """Compile the fs-sharded train step AND serve executor in-process
     under DIFACTO_HLOSCAN=1 and return the scan (hloscan.programs()).
 
@@ -72,9 +73,21 @@ def drive_scan(fs: int, capacity: int, budget: int) -> dict:
 
     # train leg: the same fused step bench --multichip measures, one
     # leg at the requested fs (capacity.py scans it explicitly too)
-    from difacto_tpu.parallel.capacity import capacity_scaling_report
+    from difacto_tpu.parallel.capacity import (bounded_delay_report,
+                                               capacity_scaling_report)
     capacity_scaling_report(fs_values=[fs], base_capacity=capacity // fs,
                             V_dim=4, batch=64, nnz_per_row=4, steps=1)
+
+    if tau > 0:
+        # bounded-delay leg: the SAME fs-sharded train step driven
+        # through the real windowed pipeline (prefetch depth 2+τ) —
+        # records per-τ scans under colon-free capacity.delay/* keys,
+        # and --check still fails on any table-axis collective the
+        # window might have introduced
+        bounded_delay_report(hosts_values=(1,), taus=(tau,), fs=fs,
+                             base_capacity=capacity // fs, V_dim=4,
+                             batch=64, nnz_per_row=4, steps=2,
+                             auc_legs=False)
 
     # serve leg: an fs-sharded read path through the real executor
     from difacto_tpu.data.rowblock import RowBlock
@@ -184,6 +197,10 @@ def main(argv=None) -> int:
                          "before this)")
     ap.add_argument("--fs", type=int, default=4,
                     help="fs degree for --scan (default 4)")
+    ap.add_argument("--tau", type=int, default=0,
+                    help="bounded-delay window for an extra --scan leg "
+                         "driving the windowed fs train step "
+                         "(0 = skip)")
     ap.add_argument("--rows", type=int, default=4096,
                     help="table capacity for --scan legs (divisible "
                          "by fs; default 4096)")
@@ -201,7 +218,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     dynamic = None
     if args.scan:
-        dynamic = drive_scan(args.fs, args.rows, args.budget)
+        dynamic = drive_scan(args.fs, args.rows, args.budget, args.tau)
     elif args.dynamic:
         dynamic = hloscan.load(args.dynamic)
     graph = build(args.root, dynamic)
