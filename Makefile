@@ -16,8 +16,8 @@ DATA_FORMAT ?= criteo
 DATA_OUT ?= $(basename $(DATA_IN)).rec
 
 .PHONY: test smoke ci lint lint-changed lint-baseline lockmap jitmap \
-	hlomap chaos fleet-chaos obs-report convert stream-bench \
-	multichip-bench kernel-parity
+	hlomap chaos fleet-chaos online-chaos obs-report convert \
+	stream-bench multichip-bench kernel-parity online-bench
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -89,6 +89,12 @@ chaos:
 fleet-chaos:
 	$(PY) -m pytest tests/ -m chaos -q -k "fleet or router or rolling"
 
+# online-learning loop suite alone (serve→log→train→reload under
+# injected faults and a SIGKILL'd trainer — docs/serving.md
+# "Continuous learning")
+online-chaos:
+	$(PY) -m pytest tests/ -m chaos -q -k online
+
 # fused-kernel acceptance (ISSUE 13; docs/perf_notes.md "Fused FM
 # kernel"): byte-identical trajectories across fused_kernel={off, jnp,
 # pallas-if-available} at fs=1 and fs=4, on-device dedup parity vs the
@@ -130,3 +136,8 @@ stream-bench:
 # metric; docs/perf_notes.md "Mesh-sharded parameter table")
 multichip-bench:
 	$(PY) bench.py --multichip
+
+# serve→log→train→reload steady state (the online.* BENCH section:
+# rows_per_s, train_behind_serve_s_p99, reload_count, label_join_rate)
+online-bench:
+	$(PY) bench.py --online

@@ -630,6 +630,125 @@ def run_serve_bench(args) -> dict:
     }
 
 
+def run_online_bench(args) -> dict:
+    """online.* section: steady state of the serve→log→train→reload
+    loop (docs/serving.md "Continuous learning"). One in-process server
+    logs served rows into an OnlineLog while the feedback loadgen
+    scores + labels them (#score/#label) and a REAL ``task=online``
+    trainer subprocess tails the log, committing generations back over
+    ``#reload``. Freshness is read from the trainer's own metrics JSONL
+    (every flush carries the train_behind_serve_s gauge), so the p99 is
+    measured across the run, not a final-state snapshot."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    from difacto_tpu.__main__ import main as difacto_main
+    from difacto_tpu.online.log import OnlineLog
+    from difacto_tpu.serve import ModelReloader, ServeServer, \
+        open_serving_store
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from loadgen import run_loadgen_feedback
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    rng = np.random.RandomState(0)
+    with tempfile.TemporaryDirectory() as td:
+        # a small labeled synthetic set: seed model + request stream
+        data = os.path.join(td, "train.libsvm")
+        with open(data, "w") as f:
+            for i in range(256):
+                ids = np.sort(rng.choice(1 << 14, args.nnz_per_row,
+                                         replace=False))
+                f.write(f"{i % 2} "
+                        + " ".join(f"{j}:1" for j in ids) + "\n")
+        with open(data, "rb") as f:
+            rows = [l for l in f.read().splitlines() if l.strip()]
+        model = os.path.join(td, "model")
+        difacto_main([f"data_in={data}", "lr=0.1", "batch_size=100",
+                      "max_num_epochs=1", "shuffle=0",
+                      "num_jobs_per_epoch=1", "report_interval=0",
+                      f"model_out={model}"])
+        log_dir = os.path.join(td, "log")
+        online_log = OnlineLog(log_dir,
+                               segment_rows=args.online_segment_rows,
+                               label_delay_s=args.online_label_delay_s,
+                               label_default="negative")
+        store, _meta, _rem = open_serving_store(model, [])
+        server = ServeServer(store, batch_size=args.serve_batch,
+                             max_delay_ms=args.serve_delay_ms,
+                             queue_cap=args.serve_queue_cap,
+                             online_log=online_log)
+        server.reloader = ModelReloader(server.executor, model,
+                                        server=server)
+        server.start()
+        metrics = os.path.join(td, "trainer.metrics.jsonl")
+        trainer = subprocess.Popen(
+            [sys.executable, "-m", "difacto_tpu", "task=online",
+             f"online_log_dir={log_dir}", f"model_out={model}",
+             "lr=0.1", "batch_size=100", "report_interval=0",
+             f"online_ckpt_interval_s={args.online_ckpt_s}",
+             f"online_endpoints={server.host}:{server.port}",
+             f"metrics_path={metrics}", "metrics_interval_s=0.5"],
+            cwd=repo,
+            env=dict(os.environ, PYTHONPATH=repo))
+        try:
+            rep = run_loadgen_feedback(
+                server.host, server.port, rows,
+                qps=args.online_qps, duration_s=args.online_seconds,
+                label_delay_s=args.online_label_delay_s,
+                label_rate=args.online_label_rate)
+            # terminate the log; the trainer drains the sealed tail,
+            # commits the final generation, and exits 0
+            online_log.end()
+            trainer_rc = trainer.wait(timeout=180)
+            reloads = server.reloader.stats()["reloads"]
+            generation = server.executor.stats()["model_generation"]
+        finally:
+            if trainer.poll() is None:
+                trainer.kill()
+                trainer.wait()
+            server.close()
+        behind = []
+        for p in (metrics + ".1", metrics):
+            if not os.path.exists(p):
+                continue
+            with open(p) as f:
+                for line in f:
+                    try:
+                        snap = json.loads(line)["metrics"]
+                    except (ValueError, KeyError):
+                        continue
+                    series = snap.get("gauges", {}).get(
+                        "train_behind_serve_s", {})
+                    behind.extend(series.values())
+        log_stats = online_log.stats()
+    return {
+        "rows_per_s": rep["achieved_qps"],
+        "train_behind_serve_s_p99":
+            round(float(np.percentile(behind, 99)), 3) if behind else 0.0,
+        "reload_count": reloads,
+        "label_join_rate":
+            round(rep["labels_acked"] / max(rep["sent"], 1), 4),
+        "model_generation": generation,
+        "trainer_rc": trainer_rc,
+        "ok": rep["ok"],
+        "err": rep["err"],
+        "shed_rate": rep["shed_rate"],
+        "labels_sent": rep["labels_sent"],
+        "labels_acked": rep["labels_acked"],
+        "rows_logged": log_stats["rows_logged"],
+        "segments_sealed": log_stats["next_seg"],
+        "config": {"qps": args.online_qps,
+                   "seconds": args.online_seconds,
+                   "segment_rows": args.online_segment_rows,
+                   "label_rate": args.online_label_rate,
+                   "label_delay_s": args.online_label_delay_s,
+                   "ckpt_interval_s": args.online_ckpt_s},
+    }
+
+
 def run_multichip(args) -> dict:
     """multichip.* section: the capacity-scaling trajectory of the
     fs-sharded slot table (difacto_tpu/parallel/capacity.py) — for each
@@ -692,6 +811,10 @@ def main() -> None:
     mode.add_argument("--serve", action="store_true",
                       help="online-serving latency/throughput ONLY: "
                            "in-process server + open-loop Poisson loadgen")
+    mode.add_argument("--online", action="store_true",
+                      help="serve→log→train→reload loop steady state "
+                           "ONLY: in-process server + feedback loadgen "
+                           "+ a task=online trainer subprocess")
     mode.add_argument("--multichip", action="store_true",
                       help="fs-sharded table capacity-scaling ONLY: "
                            "table of --multichip-capacity * fs rows per "
@@ -712,6 +835,18 @@ def main() -> None:
     ap.add_argument("--serve-batch", type=int, default=256)
     ap.add_argument("--serve-delay-ms", type=float, default=2.0)
     ap.add_argument("--serve-queue-cap", type=int, default=1024)
+    ap.add_argument("--online-qps", type=float, default=200.0,
+                    help="offered rate for the --online loop bench")
+    ap.add_argument("--online-seconds", type=float, default=6.0)
+    ap.add_argument("--online-segment-rows", type=int, default=64,
+                    help="rows per sealed training-log segment")
+    ap.add_argument("--online-label-rate", type=float, default=0.5,
+                    help="fraction of served rows the feedback loadgen "
+                         "labels back")
+    ap.add_argument("--online-label-delay-s", type=float, default=0.5,
+                    help="feedback-join horizon (labels go out at half)")
+    ap.add_argument("--online-ckpt-s", type=float, default=1.0,
+                    help="trainer generation commit cadence (wall s)")
     ap.add_argument("--e2e-rows", type=int, default=1_800_000,
                     help="rows in the e2e window; large enough that the "
                          "fixed epoch-boundary cost (final metric fetch, "
@@ -745,6 +880,9 @@ def main() -> None:
         return
     if args.serve:
         print(json.dumps({"serve": run_serve_bench(args)}))
+        return
+    if args.online:
+        print(json.dumps({"online": run_online_bench(args)}))
         return
     if args.multichip:
         print(json.dumps({"multichip": run_multichip(args)}))

@@ -12,6 +12,9 @@ config file + CLI overrides into KWArgs, dispatch on ``task``:
 - ``serve`` — online inference server over a saved model (serve/: dynamic
   micro-batching over the bucketed predict executor; no reference analog —
   the WSDM'16 system trained the models its production stack served).
+- ``online`` — continuous learning: tail a serve-fleet training log,
+  checkpoint on a wall-clock cadence, push each generation to the fleet
+  (online/: the serve→log→train→reload loop, docs/serving.md).
 
 Unknown leftover keys warn, as in main.cc:40-46.
 """
@@ -32,7 +35,7 @@ log = logging.getLogger("difacto_tpu")
 @dataclass
 class DifactoParam(Param):
     task: str = field(default="train", metadata=dict(
-        enum=["train", "dump", "pred", "convert", "serve"]))
+        enum=["train", "dump", "pred", "convert", "serve", "online"]))
     learner: str = "sgd"
 
 
@@ -139,6 +142,9 @@ def main(argv: list[str] | None = None) -> int:
     elif param.task == "serve":
         from .serve import run_serve
         warn_unknown(run_serve(remain))
+    elif param.task == "online":
+        from .online import run_online
+        warn_unknown(run_online(remain))
     elif param.task == "dump":
         warn_unknown(run_dump(remain))
     elif param.task == "convert":

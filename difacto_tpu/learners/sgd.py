@@ -660,8 +660,11 @@ class SGDLearner(Learner):
             static_argnums=1)
 
     # ----------------------------------------------------------- driver
-    def run(self) -> None:
-        """RunScheduler (sgd_learner.cc:52-122)."""
+    def _init_run_state(self) -> None:
+        """Per-run state the epoch loop depends on: flusher, report
+        accumulator, reporter monitor. Shared by run() and the online
+        trainer (online/trainer.py), which drives _run_epoch directly
+        per sealed log segment."""
         p = self.param
         self._start_time = time.monotonic()
         if p.metrics_path and self._flusher is None:
@@ -684,6 +687,11 @@ class SGDLearner(Learner):
         self._last_row_t = time.monotonic()
         self.reporter = Reporter(every=1)
         self.reporter.set_monitor(self._on_report)
+
+    def run(self) -> None:
+        """RunScheduler (sgd_learner.cc:52-122)."""
+        p = self.param
+        self._init_run_state()
         pre_loss, pre_val_auc = 0.0, 0.0
         k = 0
 
@@ -745,26 +753,7 @@ class SGDLearner(Learner):
 
             if p.ckpt_interval > 0 and p.model_out \
                     and (k + 1) % p.ckpt_interval == 0:
-                # periodic checkpoint WITH optimizer state so a restarted
-                # run continues the exact trajectory; the meta marker is
-                # written last (by host 0) so a crash mid-save resumes
-                # from the previous complete epoch
-                self.store.save(self._model_name(p.model_out, k),
-                                save_aux=True, epoch=k)
-                if self._host_rank == 0:
-                    self._write_ckpt_meta(k)
-                    if p.ckpt_keep > 0:
-                        # rank 0 prunes the WHOLE generation family
-                        # (every rank's _iter-* parts via the meta+glob
-                        # scan) — per-rank pruning left an evicted
-                        # rank's stale parts behind forever, since the
-                        # rank that wrote them is gone (ROADMAP leftover
-                        # from PR 3). Safe concurrently with peers still
-                        # writing: only epochs older than the newest
-                        # ckpt_keep are removed, and no rank rewrites an
-                        # old generation.
-                        from ..utils import manifest as mft
-                        mft.prune_checkpoints(p.model_out, p.ckpt_keep)
+                self._save_checkpoint(k)
 
             # stop criteria (sgd_learner.cc:92-110): the reference divides by
             # pre_loss with no zero guard — first epoch never triggers
@@ -802,6 +791,30 @@ class SGDLearner(Learner):
         if self._flusher is not None:
             self._flusher.close()
             self._flusher = None
+
+    def _save_checkpoint(self, epoch: int) -> None:
+        """Commit one resumable generation: a checkpoint WITH optimizer
+        state so a restarted run continues the exact trajectory; the
+        meta marker is written last (by host 0) so a crash mid-save
+        resumes from the previous complete epoch. Shared by the
+        epoch-cadence path (run) and the wall-clock cadence of the
+        online trainer (online/trainer.py)."""
+        p = self.param
+        self.store.save(self._model_name(p.model_out, epoch),
+                        save_aux=True, epoch=epoch)
+        if self._host_rank == 0:
+            self._write_ckpt_meta(epoch)
+            if p.ckpt_keep > 0:
+                # rank 0 prunes the WHOLE generation family (every
+                # rank's _iter-* parts via the meta+glob scan) —
+                # per-rank pruning left an evicted rank's stale parts
+                # behind forever, since the rank that wrote them is
+                # gone (ROADMAP leftover from PR 3). Safe concurrently
+                # with peers still writing: only epochs older than the
+                # newest ckpt_keep are removed, and no rank rewrites an
+                # old generation.
+                from ..utils import manifest as mft
+                mft.prune_checkpoints(p.model_out, p.ckpt_keep)
 
     # ----------------------------------------------------------- epochs
     def _model_name(self, prefix: str, it: int) -> str:

@@ -1,0 +1,184 @@
+"""Log-tailing online trainer (ISSUE 17b/17c).
+
+Drives the existing :class:`SGDLearner` through its normal streamed
+epoch machinery (``_run_epoch`` → producer pool → fused steps), but the
+"epoch" unit is one sealed log segment: the tailing reader
+(online/tail.py) blocks on the next seal, the trainer points
+``data_in`` at that one segment file and runs a training pass over it.
+Because segment files are ordinary rec2 members and each pass uses
+``shuffle=0`` with a single job, replaying the same sealed log offline
+(``online_replay=1``) issues the *identical* sequence of
+``_run_epoch(seg, ...)`` calls over the identical bytes — which is the
+trajectory-integrity contract: the replayed checkpoint is
+byte-identical to the online one.
+
+Checkpoints follow a WALL-CLOCK cadence (``online_ckpt_interval_s``),
+not an epoch cadence — a continuous stream has no natural epoch
+boundary — through the learner's verified-manifest path
+(``_save_checkpoint``: save-with-aux, meta marker last, rank-0 family
+pruning under ``ckpt_keep``; fs-sharded families included). Crash
+recovery is the existing ``auto_resume`` walk-back: the completed epoch
+the learner resumes IS the last trained-through segment, so the trainer
+restarts tailing at the next one.
+
+Freshness SLO gauges (process-global registry, so they ride any
+in-process server's ``#metrics`` and the trainer's ``metrics_path``
+JSONL → ``tools/obs_report.py``):
+
+- ``train_behind_serve_s`` — seconds the oldest sealed-but-untrained
+  segment has been waiting (0 when trained through the newest seal);
+  seal timestamps are CLOCK_MONOTONIC (machine-wide), written by the
+  logging process into ``log.idx.jsonl``.
+- ``online_rows_behind`` — rows in sealed segments not yet trained.
+
+Each committed generation is pushed to the fleet (online/loop.py) so
+the served ``model_generation`` continuously advances.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from ..config import KWArgs, parse_endpoints
+from ..obs import gauge
+from ..utils.locktrace import mutex
+from .log import read_index
+from .tail import TailReader
+
+log = logging.getLogger("difacto_tpu")
+
+_g_behind_s = gauge(
+    "train_behind_serve_s",
+    "seconds the oldest sealed-but-untrained log segment has waited "
+    "(0 = trained through the newest seal)")
+_g_rows_behind = gauge(
+    "online_rows_behind",
+    "rows in sealed log segments the online trainer has not trained yet")
+
+
+class OnlineTrainer:
+    def __init__(self, param, learner_kwargs: KWArgs):
+        self.param = param
+        # the learner consumes one SEGMENT FILE per pass: rec format,
+        # one job, no shuffle (batch order = arrival order), no device
+        # cache (every segment is new data — staging would never replay).
+        # Appended AFTER the user's kwargs so they win (last occurrence
+        # wins, config.init_allow_unknown).
+        forced = [("data_format", "rec"), ("num_jobs_per_epoch", "1"),
+                  ("shuffle", "0"), ("device_cache_mb", "0"),
+                  ("data_in", param.online_log_dir)]
+        from ..learners import Learner
+        self.learner = Learner.create("sgd")
+        self.leftover = self.learner.init(list(learner_kwargs) + forced)
+        self._mu = mutex()
+        self._trained_through = -1
+        self._generations = 0
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ state
+    def stop(self) -> None:
+        """Ask the tail loop to exit after the current segment."""
+        self._stop.set()
+
+    def trained_through(self) -> int:
+        with self._mu:
+            return self._trained_through
+
+    def generations(self) -> int:
+        with self._mu:
+            return self._generations
+
+    # ------------------------------------------------------------- run
+    def run(self) -> int:
+        """Tail the log until it ends (``log.end``), the replay prefix
+        drains, ``online_max_seconds`` elapses, or :meth:`stop`. Returns
+        the last trained-through segment (-1 = none)."""
+        from ..learners.sgd import K_TRAINING
+        from ..utils.progress import Progress
+        op = self.param
+        ln = self.learner
+        p = ln.param
+        if not p.model_out:
+            raise ValueError("task=online needs model_out")
+        endpoints = (parse_endpoints(op.online_endpoints)
+                     if op.online_endpoints else [])
+        ln._init_run_state()
+        start_seg = 0
+        if p.auto_resume:
+            resumed = ln._try_resume()
+            if resumed is not None:
+                start_seg = resumed + 1
+                log.info("online: auto-resumed through segment %d",
+                         resumed)
+        trained = start_seg - 1
+        last_saved = trained
+        last_ckpt = time.monotonic()
+        tail = TailReader(op.online_log_dir, start_seg=start_seg,
+                          poll_s=op.online_poll_s,
+                          replay=op.online_replay,
+                          max_seconds=op.online_max_seconds,
+                          stop=self._stop)
+        for seg, path in tail:
+            # one training pass over exactly this sealed segment; the
+            # segment index is the epoch, so epoch-derived behavior
+            # (embedding count push on epoch 0 only) matches a replay
+            ln.param.data_in = path
+            prog = Progress()
+            ln._run_epoch(seg, K_TRAINING, prog)
+            trained = seg
+            with self._mu:
+                self._trained_through = seg
+            self._update_freshness(trained)
+            log.info("online: segment %d trained (%s)", seg, prog.text())
+            now = time.monotonic()
+            if (op.online_ckpt_interval_s > 0
+                    and now - last_ckpt >= op.online_ckpt_interval_s):
+                self._commit(trained, endpoints)
+                last_saved = trained
+                last_ckpt = time.monotonic()
+        if trained > last_saved:
+            # the log ended (or the loop was stopped) past the last
+            # committed generation: commit the tail so nothing trained
+            # is lost and the fleet serves the final state
+            self._commit(trained, endpoints)
+        self._update_freshness(trained)
+        log.info("online: done, trained through segment %d", trained)
+        ln.store.save(ln._model_name(p.model_out, -1), p.has_aux)
+        if ln.store.fs_count > 1:
+            ln.store.publish_shard_stats()
+        ln.stop()
+        return trained
+
+    # --------------------------------------------------------- internal
+    def _commit(self, seg: int, endpoints: List[Tuple[str, int]]) -> None:
+        """One committed generation: verified checkpoint (meta marker
+        last, family pruning) then a best-effort fleet push."""
+        ln = self.learner
+        ln._save_checkpoint(seg)
+        with self._mu:
+            self._generations += 1
+        if endpoints:
+            from .loop import push_reload
+            push_reload(endpoints,
+                        ln.param.model_out + f"_iter-{seg}")
+
+    def _update_freshness(self, trained: int) -> None:
+        behind_rows = 0
+        oldest_ts: Optional[float] = None
+        for entry in read_index(self.param.online_log_dir):
+            try:
+                seg, rows, ts = (int(entry["seg"]), int(entry["rows"]),
+                                 float(entry["ts"]))
+            except (KeyError, TypeError, ValueError):
+                continue
+            if seg > trained:
+                behind_rows += rows
+                if oldest_ts is None or ts < oldest_ts:
+                    oldest_ts = ts
+        behind_s = (max(0.0, time.monotonic() - oldest_ts)
+                    if oldest_ts is not None else 0.0)
+        _g_behind_s.set(behind_s)
+        _g_rows_behind.set(float(behind_rows))

@@ -86,6 +86,22 @@ class ServeParam(Param):
     # `#handoff <ready_file>`: wait at most this long for the successor
     # before draining anyway (the handoff asked this replica to leave)
     serve_handoff_wait_s: float = field(default=30.0, metadata=dict(lo=0))
+    # online continuous learning (online/, docs/serving.md "Continuous
+    # learning"): append every served row to this training-log
+    # directory; the tailing trainer (task=online) consumes it. Empty =
+    # no logging. NOTE: one log instance per directory — CLI replicas
+    # need per-replica directories (or share one in-process OnlineLog
+    # built by the embedding harness, as bench/tests do).
+    online_log_dir: str = ""
+    # rows per sealed rec2 segment
+    online_segment_rows: int = field(default=256, metadata=dict(lo=1))
+    # feedback-join horizon: how long a served row waits for its
+    # delayed label before resolving to the default
+    label_delay_s: float = field(default=1.0, metadata=dict(lo=0))
+    # what an unlabeled row becomes past the horizon: drop it, or keep
+    # it with label 0 (the ad-click non-click convention)
+    label_default: str = field(default="negative", metadata=dict(
+        enum=["drop", "negative"]))
     data_format: str = "libsvm"
     pred_prob: bool = True
 
@@ -109,6 +125,13 @@ def run_serve(kwargs: KWArgs) -> KWArgs:
     # would silently de-shard the table
     store_kwargs = list(remain)
     store, meta, remain = open_serving_store(param.model_in, remain)
+    online_log = None
+    if param.online_log_dir:
+        from ..online.log import OnlineLog
+        online_log = OnlineLog(param.online_log_dir,
+                               segment_rows=param.online_segment_rows,
+                               label_delay_s=param.label_delay_s,
+                               label_default=param.label_default)
     server = ServeServer(
         store, host=param.serve_host, port=param.serve_port,
         batch_size=param.serve_batch_size,
@@ -119,7 +142,8 @@ def run_serve(kwargs: KWArgs) -> KWArgs:
         report_every_s=param.serve_report_every,
         drain_timeout_s=param.serve_drain_timeout_s,
         takeover=param.serve_takeover,
-        handoff_wait_s=param.serve_handoff_wait_s)
+        handoff_wait_s=param.serve_handoff_wait_s,
+        online_log=online_log)
     server.ready_file = param.serve_ready_file
     # server= attaches the blue/green path: a geometry-changing reload
     # warms a second executor and swaps it under the batcher instead of
@@ -150,6 +174,11 @@ def run_serve(kwargs: KWArgs) -> KWArgs:
     finally:
         reloader.close()
         server.close()
+        if online_log is not None:
+            # flush, do NOT end(): a restarting replica must not
+            # terminate the trainer's tail — only the operator (or the
+            # harness driving the loop) ends the log
+            online_log.flush()
         log.info("serve done: %s", server.stats_snapshot())
     return remain
 

@@ -22,7 +22,17 @@ Wire protocol (newline-delimited, UTF-8/ASCII):
   while the incumbent drains — established connections stay with their
   owner, new connections land on whichever replica still listens
   (tools/takeover.py sequences spawn -> warm -> handoff -> exit;
-  ``serve.handoff`` is a chaos injection point).
+  ``serve.handoff`` is a chaos injection point);
+- ``#score <id> <row>`` -> score ``row`` exactly like a plain request
+  line AND (when an online training log is attached) log it under the
+  client-chosen integer id, so the client can later report the row's
+  true label; the response is the plain ``%g`` score line;
+- ``#label <id> <y>`` -> feedback join for the online log: attach the
+  delayed label ``y`` to the still-pending logged row ``id``
+  (online/log.py). One JSON line back: ``{"ok": true}`` joined,
+  ``{"ok": false}`` the row already resolved (past its
+  ``label_delay_s`` horizon) — best-effort by design. Plain rows are
+  logged too (auto-assigned ids) and resolve via the horizon default.
 
 One reader + one writer thread per connection: the reader parses and
 admits rows into the shared MicroBatcher, the writer resolves futures in
@@ -52,6 +62,13 @@ from ..utils.locktrace import mutex
 
 log = logging.getLogger("difacto_tpu")
 
+from ..obs import counter as _counter  # noqa: E402
+
+_c_log_drops = _counter(
+    "online_log_drops_total",
+    "served rows the online training log failed to append (the row was "
+    "still answered — serving never fails because logging failed)")
+
 
 class ServeServer:
     def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
@@ -61,7 +78,7 @@ class ServeServer:
                  max_row_nnz: int = 4096, report_every_s: float = 30.0,
                  reporter: Optional[Reporter] = None,
                  drain_timeout_s: float = 10.0, takeover: bool = False,
-                 handoff_wait_s: float = 30.0):
+                 handoff_wait_s: float = 30.0, online_log=None):
         self.executor = PredictExecutor(store, loss=loss)
         if reporter is None:
             reporter = Reporter(every=1)
@@ -83,6 +100,10 @@ class ServeServer:
         # attached by run_serve / bench: a reload.ModelReloader serving
         # the #reload control line and the background model watcher
         self.reloader = None
+        # the serve→log→train loop (online/log.py): every admitted row
+        # is appended (plain rows under auto ids, #score rows under the
+        # client's id); #label joins delayed feedback. None = no logging.
+        self.online_log = online_log
         self.draining = False
         # takeover state (#handoff): ready_file is set by run_serve so a
         # handoff addressed at "our own" ready file is recognized as
@@ -109,6 +130,10 @@ class ServeServer:
         self._conns: set = set()
         self._conn_threads: list = []
         self._mu = mutex()
+        # serve_generation_age_s bookkeeping: when the served generation
+        # last advanced (detected at #metrics render time, under _mu)
+        self._gen_seen = self.executor.generation
+        self._gen_ts = time.monotonic()
 
     # ---------------------------------------------------------- control
     def start(self) -> "ServeServer":
@@ -306,41 +331,15 @@ class ServeServer:
                 if not line:
                     continue
                 if line.startswith(b"#"):
-                    replies.put(("raw", self._control(line), 0.0))
+                    # #score rides the batcher (its reply is a scored
+                    # future, not control bytes), so it is admitted
+                    # here, not in _control
+                    if line.startswith(b"#score "):
+                        replies.put(self._admit_scored(line))
+                    else:
+                        replies.put(("raw", self._control(line), 0.0))
                     continue
-                t0 = time.monotonic()
-                if self.draining:
-                    # starts with !shed so every client treats it as the
-                    # retry-elsewhere backpressure signal it is
-                    self.stats.record_shed()
-                    replies.put(("raw", b"!shed draining\n", 0.0))
-                    continue
-                try:
-                    blk = self._parser(line)
-                except Exception as e:
-                    log.debug("bad row %r: %s", line[:80], e)
-                    blk = None
-                if blk is None or blk.size != 1:
-                    self.stats.record_error()
-                    replies.put(("raw", b"!err bad row\n", 0.0))
-                    continue
-                if blk.nnz > self.max_row_nnz:
-                    self.stats.record_error()
-                    replies.put((
-                        "raw",
-                        b"!err row exceeds serve_max_row_nnz=%d\n"
-                        % self.max_row_nnz, 0.0))
-                    continue
-                try:
-                    fut = self.batcher.submit(blk)
-                except faultinject.FaultInjected as e:
-                    self.stats.record_error()
-                    replies.put(("raw", b"!err %s\n" % str(e).encode(), 0.0))
-                    continue
-                if fut is None:
-                    replies.put(("raw", b"!shed\n", 0.0))
-                else:
-                    replies.put(("fut", fut, t0))
+                replies.put(self._admit(line))
         except (OSError, ValueError):
             pass
         finally:
@@ -352,6 +351,69 @@ class ServeServer:
                 pass
             with self._mu:
                 self._conns.discard(conn)
+
+    def _admit(self, row: bytes, row_id: Optional[int] = None):
+        """Parse + admit one data row into the micro-batcher; returns
+        the writer-queue item (``("fut", future, t0)`` or a raw reply).
+        Shared by the plain request path and ``#score``."""
+        t0 = time.monotonic()
+        if self.draining:
+            # starts with !shed so every client treats it as the
+            # retry-elsewhere backpressure signal it is
+            self.stats.record_shed()
+            return ("raw", b"!shed draining\n", 0.0)
+        try:
+            blk = self._parser(row)
+        except Exception as e:
+            log.debug("bad row %r: %s", row[:80], e)
+            blk = None
+        if blk is None or blk.size != 1:
+            self.stats.record_error()
+            return ("raw", b"!err bad row\n", 0.0)
+        if blk.nnz > self.max_row_nnz:
+            self.stats.record_error()
+            return ("raw",
+                    b"!err row exceeds serve_max_row_nnz=%d\n"
+                    % self.max_row_nnz, 0.0)
+        try:
+            fut = self.batcher.submit(blk)
+        except faultinject.FaultInjected as e:
+            self.stats.record_error()
+            return ("raw", b"!err %s\n" % str(e).encode(), 0.0)
+        if fut is None:
+            return ("raw", b"!shed\n", 0.0)
+        # log AFTER a successful admit: the training log records rows
+        # that were actually served, not shed/rejected ones
+        self._log_row(blk, row_id)
+        return ("fut", fut, t0)
+
+    def _admit_scored(self, line: bytes):
+        """``#score <id> <row>``: score exactly like a plain row, logged
+        under the client-chosen id so ``#label <id> <y>`` can join."""
+        parts = line.split(None, 2)
+        if len(parts) != 3:
+            self.stats.record_error()
+            return ("raw", b"!err bad #score line\n", 0.0)
+        try:
+            rid = int(parts[1])
+        except ValueError:
+            self.stats.record_error()
+            return ("raw", b"!err bad #score id\n", 0.0)
+        return self._admit(parts[2], row_id=rid)
+
+    def _log_row(self, blk, row_id: Optional[int]) -> None:
+        """Append a served row to the online training log. A logging
+        failure (injected ``online.log.append``, disk trouble) is
+        counted and the row is still answered — the serve path never
+        fails because the training log did."""
+        online_log = self.online_log
+        if online_log is None:
+            return
+        try:
+            online_log.append(blk, row_id=row_id)
+        except Exception as e:
+            _c_log_drops.inc()
+            log.debug("online log append dropped row: %s", e)
 
     def metrics_text(self) -> str:
         """Prometheus text for the ``#metrics`` control line: the
@@ -374,6 +436,18 @@ class ServeServer:
         self.obs.gauge("serve_draining",
                        "1 while draining for shutdown"
                        ).set(1.0 if self.draining else 0.0)
+        # freshness SLO (docs/serving.md "Continuous learning"): how
+        # stale the serving model is — seconds since the served
+        # generation last advanced, detected at render time
+        now = time.monotonic()
+        with self._mu:
+            if ex["model_generation"] != self._gen_seen:
+                self._gen_seen = ex["model_generation"]
+                self._gen_ts = now
+            gen_age = now - self._gen_ts
+        self.obs.gauge("serve_generation_age_s",
+                       "seconds since the serving model generation "
+                       "last advanced").set(gen_age)
         if self.reloader is not None:
             rs = self.reloader.stats()
             self.obs.gauge("serve_reloads",
@@ -398,6 +472,8 @@ class ServeServer:
             return self.metrics_text().encode() + b"\n"
         if line == b"#health":
             return (json.dumps(self.health_snapshot()) + "\n").encode()
+        if line.startswith(b"#label "):
+            return self._control_label(line)
         if line == b"#handoff" or line.startswith(b"#handoff "):
             return self._control_handoff(line)
         if line == b"#reload" or line.startswith(b"#reload "):
@@ -409,6 +485,27 @@ class ServeServer:
             path = line[len(b"#reload"):].strip().decode() or None
             return (json.dumps(self.reloader.reload(path)) + "\n").encode()
         return b"!err unknown control %s\n" % line[:32]
+
+    def _control_label(self, line: bytes) -> bytes:
+        """``#label <id> <y>``: delayed-feedback join onto the online
+        training log. Typed replies for every failure shape — a label
+        for a row past its horizon is ``{"ok": false}``, not an error."""
+        online_log = self.online_log
+        if online_log is None:
+            return b"!err no online log attached\n"
+        parts = line.split()
+        if len(parts) != 3:
+            return b"!err bad #label line\n"
+        try:
+            rid, y = int(parts[1]), float(parts[2])
+        except ValueError:
+            return b"!err bad #label args\n"
+        try:
+            joined = online_log.label(rid, y)
+        except faultinject.FaultInjected as e:
+            self.stats.record_error()
+            return b"!err %s\n" % str(e).encode()
+        return (json.dumps({"ok": joined}) + "\n").encode()
 
     def _control_handoff(self, line: bytes) -> bytes:
         """``#handoff [ready_file]``: acknowledge, then wait for the

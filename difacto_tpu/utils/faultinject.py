@@ -34,7 +34,15 @@ run of a real cluster) arm through one environment variable:
   push, parallel/multihost.py post_clock — ``err`` models a host
   failing mid-τ-window while peers may be staged ahead against its
   clock; the typed failure must surface through the windowed exchange
-  pipeline, not wedge it).
+  pipeline, not wedge it), ``online.log.append`` (the serve path
+  appending a served row to the online training log, online/log.py —
+  ``err`` must drop only the log entry, counted in
+  ``online_log_drops_total``, while the row is still answered),
+  ``online.label_join`` (the delayed-label feedback join — ``err``
+  surfaces as a typed ``!err`` reply to the reporting client, the
+  connection stays up), ``online.seal`` (committing a full segment —
+  ``err`` keeps the resolved buffer in memory and retries on the next
+  advance, so a transient seal failure never loses rows).
 - ``kind`` — what happens when the fault fires:
     - ``err``      raise :class:`FaultInjected` (an OSError, so IO call
                    sites treat it exactly like a real IO failure);

@@ -1,0 +1,657 @@
+"""Online continuous learning (ISSUE 17): the serve→log→train→reload
+loop — training-log mechanics (append / delayed-label join / horizon
+defaults / sealed segments), the tailing trainer and ``task=online``,
+trajectory integrity (offline replay reproduces the online checkpoint
+byte-identically), golden parity (online-trained model serves
+bit-for-bit with task=pred, through the routed fleet and at
+serve_mesh_fs=2), the watcher-vs-pruner reload race, the three
+``online.*`` fault points, freshness SLO gauges, and the SIGKILL'd-
+trainer chaos leg.
+
+Conventions: network/subprocess-bearing tests run under an explicit
+SIGALRM deadline (the test_serve.py convention); the end-to-end legs
+carry the ``chaos`` marker (in tier-1, selectable with ``-m chaos``;
+``make online-chaos`` runs just these).
+"""
+
+import contextlib
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from difacto_tpu.__main__ import main
+from difacto_tpu.obs import REGISTRY
+from difacto_tpu.online import OnlineLog, TailReader, push_reload
+from difacto_tpu.online.log import list_segments, read_index, seg_path
+from difacto_tpu.utils import faultinject
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@contextlib.contextmanager
+def deadline(seconds: int):
+    def on_alarm(signum, frame):
+        raise TimeoutError(f"test exceeded {seconds}s deadline")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No injected fault may leak across tests."""
+    yield
+    faultinject.configure("")
+
+
+def fixture_rows(rcv1_path):
+    with open(rcv1_path, "rb") as f:
+        return [l for l in f.read().splitlines() if l.strip()]
+
+
+def _parse_row(row: bytes):
+    from difacto_tpu.data.parsers import get_parser
+    return get_parser("libsvm")(row)
+
+
+def _read_back(path: str):
+    """One RowBlock over a sealed segment, via the normal rec reader."""
+    from difacto_tpu.data.reader import Reader
+    from difacto_tpu.data.rowblock import RowBlock
+    blocks = list(Reader(path, "rec", 0, 1))
+    return blocks[0] if len(blocks) == 1 else RowBlock.concat(blocks)
+
+
+def _wait_for(cond, seconds: float, what: str):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < seconds:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out after {seconds}s waiting for {what}")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------- log unit mechanics
+
+def test_log_roundtrip_labels_segments_index(tmp_path):
+    """Append + join + seal: rows resolve in arrival order with their
+    joined labels, seal as ordinary rec2 members every segment_rows, the
+    index records each seal, and the log's bookkeeping files stay
+    invisible to rec readers."""
+    from difacto_tpu.data.rec import rec_members
+    log_dir = str(tmp_path / "olog")
+    olog = OnlineLog(log_dir, segment_rows=4, label_delay_s=3600.0)
+    src = [_parse_row(b"1 %d:1 %d:2" % (3 + i, 50 + i)) for i in range(8)]
+    for i, blk in enumerate(src):
+        assert olog.append(blk, row_id=i) == i
+        assert olog.label(i, float(i % 2))
+    assert list_segments(log_dir) == [0, 1]
+    for s in (0, 1):
+        blk = _read_back(seg_path(log_dir, s))
+        assert blk.size == 4
+        assert blk.label.tolist() == [0.0, 1.0, 0.0, 1.0]
+        # arrival order preserved: row i's ids are {3+i, 50+i}
+        for r in range(4):
+            ids = blk.index[blk.offset[r]:blk.offset[r + 1]]
+            assert set(int(x) for x in ids) == {3 + 4 * s + r,
+                                                50 + 4 * s + r}
+    idx = read_index(log_dir)
+    assert [(e["seg"], e["rows"]) for e in idx] == [(0, 4), (1, 4)]
+    assert all(e["ts"] > 0 for e in idx)
+    # log.idx.jsonl and log.end never reach the block readers
+    olog.end()
+    members = [m for m, _ in rec_members([log_dir])]
+    assert len(members) == 2 and all(m.endswith(".rec2") for m in members)
+    # a resolved row can no longer be labeled; stats are coherent
+    assert not olog.label(0, 1.0)
+    st = olog.stats()
+    assert st["rows_logged"] == 8 and st["pending"] == 0
+    assert st["buffered"] == 0 and st["next_seg"] == 2
+    # a restarting writer resumes numbering past the sealed segments
+    assert OnlineLog(log_dir).stats()["next_seg"] == 2
+    with pytest.raises(ValueError, match="label_default"):
+        OnlineLog(str(tmp_path / "x"), label_default="bogus")
+
+
+def test_label_horizon_default_negative_vs_drop(tmp_path):
+    """An unlabeled row past the label_delay_s horizon resolves to the
+    configured default: label 0.0 under ``negative``, excluded from the
+    log under ``drop``."""
+    before = REGISTRY.value("online_label_defaults_total")
+    neg = OnlineLog(str(tmp_path / "neg"), segment_rows=2,
+                    label_delay_s=0.05, label_default="negative")
+    neg.append(_parse_row(b"1 3:1"), row_id=0)
+    neg.append(_parse_row(b"1 4:1"), row_id=1)
+    assert list_segments(neg.log_dir) == []      # still inside the horizon
+    time.sleep(0.1)
+    neg.poll()                                   # expiry without traffic
+    assert list_segments(neg.log_dir) == [0]
+    blk = _read_back(seg_path(neg.log_dir, 0))
+    assert blk.size == 2 and blk.label.tolist() == [0.0, 0.0]
+    assert REGISTRY.value("online_label_defaults_total") - before == 2
+
+    drop = OnlineLog(str(tmp_path / "drop"), segment_rows=2,
+                     label_delay_s=0.05, label_default="drop")
+    drop.append(_parse_row(b"1 3:1"), row_id=0)
+    drop.append(_parse_row(b"1 4:1"), row_id=1)
+    time.sleep(0.1)
+    drop.flush()
+    assert list_segments(drop.log_dir) == []
+    assert drop.stats()["rows_dropped"] == 2
+    # a labeled row behind the dropped pair still makes it out
+    drop.append(_parse_row(b"1 5:1"), row_id=2)
+    drop.label(2, 1.0)
+    drop.flush()
+    blk = _read_back(seg_path(drop.log_dir, 0))
+    assert blk.size == 1 and blk.label.tolist() == [1.0]
+
+
+def test_tail_reader_replay_end_stop_and_deadline(tmp_path):
+    """TailReader terminates on each of its four exits: replay gap,
+    log.end (written after the final seal — the hand-off is race-free),
+    stop event, and max_seconds."""
+    log_dir = str(tmp_path / "olog")
+    olog = OnlineLog(log_dir, segment_rows=1, label_delay_s=3600.0)
+    for i in range(2):
+        olog.append(_parse_row(b"1 3:1"), row_id=i)
+        olog.label(i, 1.0)
+    assert list_segments(log_dir) == [0, 1]
+    # replay: drain the finished prefix, stop at the gap
+    got = list(TailReader(log_dir, replay=True))
+    assert got == [(0, seg_path(log_dir, 0)), (1, seg_path(log_dir, 1))]
+    with deadline(60):
+        # live tail: a reader blocked on seg 2 sees the seal, then ends
+        out = []
+
+        def tail():
+            out.extend(s for s, _ in TailReader(log_dir, poll_s=0.01))
+
+        t = threading.Thread(target=tail)
+        t.start()
+        time.sleep(0.1)
+        olog.append(_parse_row(b"1 4:1"), row_id=2)
+        olog.label(2, 0.0)
+        olog.end()
+        t.join(timeout=30)
+        assert not t.is_alive() and out == [0, 1, 2]
+    # stop event pre-set: returns without yielding the missing segment
+    ev = threading.Event()
+    ev.set()
+    assert list(TailReader(log_dir, start_seg=99, stop=ev)) == []
+    # bounded lifetime
+    t0 = time.monotonic()
+    assert list(TailReader(str(tmp_path / "empty"), poll_s=0.01,
+                           max_seconds=0.05)) == []
+    assert time.monotonic() - t0 < 5
+
+
+# -------------------------------------- trained-loop fixtures (module)
+
+def _online_args(log_dir, model, extra=()):
+    # l1=0.1 (not the golden suite's l1=1): one online pass over each
+    # row must leave real weights behind, not prune the store empty
+    return ["task=online", f"online_log_dir={log_dir}",
+            f"model_out={model}", "lr=1", "l1=0.1", "l2=1",
+            "batch_size=100", "report_interval=0", *extra]
+
+
+@pytest.fixture(scope="module")
+def online_log(rcv1_path, tmp_path_factory):
+    """A finished 4-segment training log over the 100 rcv1 fixture rows,
+    every row joined with its true label (huge horizon: resolve-on-label,
+    so the sealed stream is exactly the labeled source rows in order)."""
+    d = tmp_path_factory.mktemp("online_log")
+    log_dir = str(d / "olog")
+    olog = OnlineLog(log_dir, segment_rows=25, label_delay_s=3600.0)
+    for i, row in enumerate(fixture_rows(rcv1_path)):
+        olog.append(_parse_row(row), row_id=i)
+        olog.label(i, float(row.split()[0]))
+    olog.end()
+    assert list_segments(log_dir) == [0, 1, 2, 3]
+    return log_dir
+
+
+@pytest.fixture(scope="module")
+def online_model(online_log, tmp_path_factory):
+    """task=online over the finished log: tail drains the 4 segments,
+    the tail-commit writes the _iter-3 generation, the final save the
+    undecorated model."""
+    d = tmp_path_factory.mktemp("online_model")
+    model = str(d / "model")
+    assert main(_online_args(online_log, model,
+                             ("online_ckpt_interval_s=0",))) == 0
+    assert os.path.exists(model + "_part-0")
+    assert os.path.exists(model + "_iter-3_part-0")
+    assert os.path.exists(model + "_iter-3_part-0.manifest.json")
+    return model
+
+
+# ---------------------------------------------------- acceptance legs
+
+def test_replay_reproduces_online_checkpoint_bytes(online_log,
+                                                   online_model,
+                                                   tmp_path):
+    """Trajectory integrity: replaying the sealed log offline
+    (online_replay=1) issues the identical segment passes over the
+    identical bytes — the final checkpoint is byte-identical to the
+    online one, array for array."""
+    model2 = str(tmp_path / "replay")
+    assert main(_online_args(online_log, model2,
+                             ("online_replay=1",
+                              "online_ckpt_interval_s=0"))) == 0
+    with np.load(online_model + "_part-0") as a, \
+            np.load(model2 + "_part-0") as b:
+        assert set(a.files) == set(b.files)
+        for k in a.files:
+            assert a[k].tobytes() == b[k].tobytes(), \
+                f"array {k!r} differs between online and replay"
+
+
+def test_online_model_golden_pred_fleet_and_fs2(online_model, rcv1_path,
+                                                tmp_path):
+    """Golden parity: the online-trained model scores the fixture rows
+    byte-identically via task=pred, through a routed 2-replica fleet
+    (fs=1), and on a single fs=2-sharded replica."""
+    from difacto_tpu.serve import (RouterServer, ServeClient, ServeServer,
+                                   open_serving_store)
+    rows = fixture_rows(rcv1_path)
+    pred_out = str(tmp_path / "pred")
+    assert main(["task=pred", f"model_in={online_model}",
+                 f"data_val={rcv1_path}", f"pred_out={pred_out}"]) == 0
+    with open(pred_out + "_part-0", "rb") as f:
+        probs = [l.split(b"\t")[1] for l in f.read().splitlines()]
+    assert len(probs) == 100 and len(set(probs)) > 1
+
+    with deadline(240):
+        srvs = []
+        for _ in range(2):
+            store, _, _ = open_serving_store(online_model)
+            srvs.append(ServeServer(store, batch_size=100,
+                                    max_delay_ms=50.0).start())
+        try:
+            router = RouterServer([(s.host, s.port) for s in srvs]).start()
+        except OSError as e:  # pragma: no cover - locked-down CI box
+            for s in srvs:
+                s.close()
+            pytest.skip(f"cannot bind the router port: {e}")
+        try:
+            with ServeClient(router.host, router.port) as c:
+                resp = c.score_lines(rows)
+            st = router.stats_snapshot()
+            assert sum(b["rows"] for b in st["backends"]) >= 100, st
+        finally:
+            router.close()
+            for s in srvs:
+                s.close()
+        assert resp == probs
+
+        store2, _, _ = open_serving_store(online_model,
+                                          [("serve_mesh_fs", "2")])
+        assert store2.fs_count == 2
+        srv = ServeServer(store2, batch_size=100,
+                          max_delay_ms=200.0).start()
+        try:
+            with ServeClient(srv.host, srv.port) as c:
+                resp2 = c.score_lines(rows)
+        finally:
+            srv.close()
+        assert resp2 == probs
+
+
+def test_reload_typed_walkback_on_pruned_generation(online_log, rcv1_path,
+                                                    tmp_path):
+    """Watcher-vs-pruner race: a replica reloading a generation that
+    rank-0 pruning just removed gets the typed walk-back ({'ok': false},
+    reload_failures counted) and KEEPS SERVING the incumbent model; the
+    next surviving generation catches it up. push_reload carries the
+    same contract per endpoint and never raises."""
+    from difacto_tpu.serve import ServeClient, ServeServer, \
+        open_serving_store
+    from difacto_tpu.serve.reload import ModelReloader
+    from difacto_tpu.utils import manifest as mft
+    rows = fixture_rows(rcv1_path)
+    # a 4-generation family: commit after every segment
+    model = str(tmp_path / "fam")
+    assert main(_online_args(online_log, model,
+                             ("online_ckpt_interval_s=0.001",))) == 0
+    for e in range(4):
+        assert os.path.exists(f"{model}_iter-{e}_part-0"), e
+
+    with deadline(120):
+        store, _, _ = open_serving_store(f"{model}_iter-3")
+        srv = ServeServer(store, batch_size=50, max_delay_ms=5.0).start()
+        srv.reloader = ModelReloader(srv.executor, f"{model}_iter-3",
+                                     server=srv)
+        try:
+            gen0 = srv.executor.stats()["model_generation"]
+            # rank-0 pruning retires the two oldest generations while
+            # this replica is about to load one of them
+            removed = mft.prune_checkpoints(model, 2)
+            assert any("_iter-0" in p for p in removed), removed
+            res = srv.reloader.reload(f"{model}_iter-0")
+            assert res["ok"] is False and res["error"], res
+            assert srv.reloader.reload_failures == 1
+            # never crashed, old model still serving at its generation
+            with ServeClient(srv.host, srv.port) as c:
+                got = c.predict(rows[:5])
+            assert all(g is not None for g in got)
+            assert srv.executor.stats()["model_generation"] == gen0
+            # the loop's push: one dead endpoint, one live replica with a
+            # surviving generation — best-effort, typed, no exception
+            dead = _free_port()
+            out = push_reload([("127.0.0.1", dead),
+                               (srv.host, srv.port)], f"{model}_iter-2")
+            assert out == {"ok": 1, "failed": 1}
+            assert srv.executor.stats()["model_generation"] == gen0 + 1
+            # pushing the pruned generation is the typed failure path
+            out = push_reload([(srv.host, srv.port)], f"{model}_iter-1")
+            assert out == {"ok": 0, "failed": 1}
+            assert srv.executor.stats()["model_generation"] == gen0 + 1
+        finally:
+            srv.close()
+
+
+# ------------------------------------------------ fault points (chaos)
+
+@pytest.mark.chaos
+def test_fault_log_append_row_still_served(online_model, rcv1_path,
+                                           tmp_path):
+    """``online.log.append:err@1``: every log append fails — the rows
+    are all still answered (the serve path never fails because the
+    training log did), the drops are counted, nothing is logged."""
+    from difacto_tpu.serve import ServeClient, ServeServer, \
+        open_serving_store
+    rows = fixture_rows(rcv1_path)[:10]
+    olog = OnlineLog(str(tmp_path / "olog"), segment_rows=4,
+                     label_delay_s=0.05)
+    drops0 = REGISTRY.value("online_log_drops_total")
+    fired0 = REGISTRY.value("faults_fired_total",
+                            point="online.log.append", kind="err")
+    with deadline(60):
+        store, _, _ = open_serving_store(online_model)
+        srv = ServeServer(store, batch_size=16, max_delay_ms=2.0,
+                          online_log=olog).start()
+        try:
+            faultinject.configure("online.log.append:err@1")
+            with ServeClient(srv.host, srv.port) as c:
+                got = c.predict(rows)
+            assert all(g is not None for g in got)
+            fired = faultinject.stats()   # read before disarm resets it
+        finally:
+            faultinject.configure("")
+            srv.close()
+    assert olog.stats()["rows_logged"] == 0
+    assert REGISTRY.value("online_log_drops_total") - drops0 == 10
+    assert fired.get("online.log.append", 0) >= 10
+    assert REGISTRY.value("faults_fired_total",
+                          point="online.log.append",
+                          kind="err") - fired0 >= 10
+
+
+@pytest.mark.chaos
+def test_fault_seal_retains_buffer_then_recovers(tmp_path):
+    """``online.seal:err@1``: a failing seal keeps the resolved buffer
+    in memory (rows are never lost) and the next advance after disarm
+    commits every row into the segment it always belonged to."""
+    olog = OnlineLog(str(tmp_path / "olog"), segment_rows=2,
+                     label_delay_s=3600.0)
+    fails0 = REGISTRY.value("online_seal_failures_total")
+    faultinject.configure("online.seal:err@1")
+    olog.append(_parse_row(b"1 3:1"), row_id=0)
+    olog.label(0, 1.0)
+    olog.append(_parse_row(b"0 4:1"), row_id=1)
+    olog.label(1, 0.0)
+    # the seal fired and failed; nothing on disk, both rows retained
+    assert faultinject.stats().get("online.seal", 0) >= 1, \
+        faultinject.stats()
+    assert list_segments(olog.log_dir) == []
+    assert olog.stats()["buffered"] == 2
+    assert REGISTRY.value("online_seal_failures_total") - fails0 >= 1
+    faultinject.configure("")
+    olog.flush()
+    assert list_segments(olog.log_dir) == [0]
+    blk = _read_back(seg_path(olog.log_dir, 0))
+    assert blk.size == 2 and blk.label.tolist() == [1.0, 0.0]
+
+
+@pytest.mark.chaos
+def test_fault_label_join_typed_err_connection_survives(online_model,
+                                                        tmp_path):
+    """``online.label_join:err@1``: the join failure surfaces as a typed
+    ``!err`` reply to the reporting client; the connection stays up and
+    the next (disarmed) label joins normally."""
+    from difacto_tpu.serve import ServeServer, open_serving_store
+    olog = OnlineLog(str(tmp_path / "olog"), segment_rows=8,
+                     label_delay_s=3600.0)
+    with deadline(60):
+        store, _, _ = open_serving_store(online_model)
+        srv = ServeServer(store, batch_size=8, max_delay_ms=2.0,
+                          online_log=olog).start()
+        sock = socket.create_connection((srv.host, srv.port), timeout=10)
+        try:
+            rf = sock.makefile("rb")
+            sock.sendall(b"#score 7 1 5:1 9:2\n")
+            line = rf.readline()
+            assert line and not line.startswith(b"!"), line
+            assert olog.stats()["rows_logged"] == 1
+            faultinject.configure("online.label_join:err@1")
+            sock.sendall(b"#label 7 1\n")
+            err = rf.readline()
+            assert err.startswith(b"!err"), err
+            fired = faultinject.stats()   # read before disarm resets it
+            faultinject.configure("")
+            sock.sendall(b"#label 7 1\n")
+            assert json.loads(rf.readline()) == {"ok": True}
+            # the row resolved on join; a duplicate label is a typed miss
+            sock.sendall(b"#label 7 0\n")
+            assert json.loads(rf.readline()) == {"ok": False}
+        finally:
+            sock.close()
+            srv.close()
+    assert fired.get("online.label_join", 0) >= 1, fired
+
+
+# ------------------------------------------- end-to-end loop (chaos)
+
+@pytest.mark.chaos
+def test_inprocess_loop_feedback_freshness_and_reports(online_model,
+                                                       rcv1_path,
+                                                       tmp_path, capsys):
+    """The loop in one process: feedback loadgen (#score/#label) against
+    a logging replica, the tailing trainer pushing generations back to
+    it — labels join, the served generation advances, the freshness SLO
+    trio rides #metrics and the trainer's metrics JSONL renders through
+    tools/obs_report.py."""
+    sys.path.insert(0, str(REPO / "tools"))
+    from loadgen import run_loadgen_feedback
+    from obs_report import load_last_snapshot, report_gauges
+
+    from difacto_tpu.online import OnlineParam, OnlineTrainer
+    from difacto_tpu.serve import ServeClient, ServeServer, \
+        open_serving_store
+    from difacto_tpu.serve.reload import ModelReloader
+    rows = fixture_rows(rcv1_path)
+    log_dir = str(tmp_path / "olog")
+    model = str(tmp_path / "model")
+    metrics = str(tmp_path / "trainer.metrics.jsonl")
+    olog = OnlineLog(log_dir, segment_rows=32, label_delay_s=0.4)
+    joined0 = REGISTRY.value("online_labels_joined_total")
+    pushes0 = REGISTRY.value("online_reload_pushes_total")
+    with deadline(300):
+        store, _, _ = open_serving_store(online_model)
+        srv = ServeServer(store, batch_size=64, max_delay_ms=2.0,
+                          online_log=olog).start()
+        srv.reloader = ModelReloader(srv.executor, model, server=srv)
+        gen0 = srv.executor.stats()["model_generation"]
+        op = OnlineParam(online_log_dir=log_dir,
+                         online_ckpt_interval_s=0.3,
+                         online_endpoints=f"{srv.host}:{srv.port}")
+        tr = OnlineTrainer(op, [
+            ("model_out", model), ("lr", "1"), ("l1", "0.1"), ("l2", "1"),
+            ("batch_size", "100"), ("report_interval", "0"),
+            ("metrics_path", metrics), ("metrics_interval_s", "0.2")])
+        res = {}
+        tt = threading.Thread(
+            target=lambda: res.update(trained=tr.run()))
+        tt.start()
+        try:
+            rep = run_loadgen_feedback(srv.host, srv.port, rows, qps=120,
+                                       duration_s=3.0, label_rate=1.0,
+                                       label_delay_s=0.3)
+            time.sleep(0.6)          # let the last horizons expire
+            olog.end()
+            tt.join(timeout=180)
+            assert not tt.is_alive(), "trainer never drained the log"
+            mt = ""
+            with ServeClient(srv.host, srv.port) as c:
+                mt = c.metrics()
+            gen1 = srv.executor.stats()["model_generation"]
+        finally:
+            if tt.is_alive():  # pragma: no cover - deadline blew
+                tr.stop()
+                tt.join(timeout=60)
+            srv.close()
+    assert rep["err"] == 0 and rep["label_errs"] == 0, rep
+    assert rep["labels_sent"] > 0 and rep["labels_acked"] > 0, rep
+    assert REGISTRY.value("online_labels_joined_total") - joined0 > 0
+    assert olog.stats()["rows_logged"] == rep["ok"], (olog.stats(), rep)
+    # the trainer drained the whole log and pushed generations back
+    assert res["trained"] == max(list_segments(log_dir))
+    assert tr.generations() >= 1
+    assert REGISTRY.value("online_reload_pushes_total") - pushes0 >= 1
+    assert gen1 > gen0, "no generation ever reached the serving replica"
+    # freshness SLO trio: on the replica's #metrics ...
+    for name in ("train_behind_serve_s", "online_rows_behind",
+                 "serve_generation_age_s"):
+        assert name in mt, f"{name} missing from #metrics"
+    # ... and in the trainer's JSONL, rendered by the obs report
+    snap = load_last_snapshot(metrics)
+    assert "train_behind_serve_s" in snap.get("gauges", {}), snap.keys()
+    report_gauges(snap)
+    out = capsys.readouterr().out
+    assert "== gauges (at last flush) ==" in out
+    assert "train_behind_serve_s" in out
+
+
+@pytest.mark.chaos
+def test_chaos_online_loop_survives_trainer_sigkill(online_model,
+                                                    rcv1_path, tmp_path):
+    """Acceptance: steady loadgen through the router against a
+    2-replica logging fleet while the subprocess trainer tails the log
+    and pushes generations; SIGKILL the trainer mid-generation — zero
+    client-visible !err, the fleet keeps serving, and after relaunch
+    (auto_resume walk-back) the served model_generation advances past
+    the pre-kill value."""
+    sys.path.insert(0, str(REPO / "tools"))
+    from loadgen import run_loadgen
+
+    from difacto_tpu.serve import (RouterServer, ServeClient, ServeServer,
+                                   open_serving_store)
+    from difacto_tpu.serve.reload import ModelReloader
+    rows = fixture_rows(rcv1_path)
+    log_dir = str(tmp_path / "olog")
+    model = str(tmp_path / "model")
+    # one shared in-process log (CLI replicas would use per-replica
+    # dirs); short horizon: rows resolve to the negative default fast
+    olog = OnlineLog(log_dir, segment_rows=128, label_delay_s=0.2)
+    proc = proc2 = None
+    with deadline(570):
+        srvs = []
+        for _ in range(2):
+            store, _, _ = open_serving_store(online_model)
+            srv = ServeServer(store, batch_size=64, max_delay_ms=2.0,
+                              online_log=olog).start()
+            srv.reloader = ModelReloader(srv.executor, model, server=srv)
+            srvs.append(srv)
+        try:
+            router = RouterServer([(s.host, s.port)
+                                   for s in srvs]).start()
+        except OSError as e:  # pragma: no cover - locked-down CI box
+            for s in srvs:
+                s.close()
+            pytest.skip(f"cannot bind the router port: {e}")
+        eps = ",".join(f"{s.host}:{s.port}" for s in srvs)
+        cmd = [sys.executable, "-m", "difacto_tpu", "task=online",
+               f"online_log_dir={log_dir}", f"model_out={model}",
+               "lr=1", "l1=0.1", "l2=1", "batch_size=100",
+               "report_interval=0", "auto_resume=1",
+               "online_ckpt_interval_s=0.5", f"online_endpoints={eps}"]
+        env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
+        reps = []
+        stop = threading.Event()
+
+        def pump():
+            while not stop.is_set():
+                reps.append(run_loadgen(router.host, router.port, rows,
+                                        qps=60, duration_s=2.0))
+
+        def gen(i):
+            return srvs[i].executor.stats()["model_generation"]
+
+        t = threading.Thread(target=pump)
+        t.start()
+        try:
+            gen0 = gen(0)
+            proc = subprocess.Popen(cmd, env=env, cwd=str(REPO),
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.DEVNULL)
+            _wait_for(lambda: gen(0) > gen0, 240,
+                      "the first pushed generation")
+            pre_kill = gen(0)
+            proc.kill()                       # SIGKILL, mid-generation
+            assert proc.wait(timeout=60) == -signal.SIGKILL
+            # the fleet keeps serving with the trainer dead
+            with ServeClient(router.host, router.port) as c:
+                got = c.predict(rows[:10])
+            assert all(g is not None for g in got)
+            # relaunch: auto_resume walks back to the last verified
+            # generation and re-tails from the next segment
+            proc2 = subprocess.Popen(cmd, env=env, cwd=str(REPO),
+                                     stdout=subprocess.DEVNULL,
+                                     stderr=subprocess.DEVNULL)
+            _wait_for(lambda: gen(0) > pre_kill, 240,
+                      "a generation advance after the relaunch")
+        finally:
+            stop.set()
+            t.join()
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        try:
+            olog.end()
+            rc = proc2.wait(timeout=240)
+            assert rc == 0, f"relaunched trainer exited {rc}"
+            # the push reached BOTH replicas
+            assert gen(1) > gen0
+            # the headline: the kill+relaunch cost the clients NOTHING
+            assert sum(r["err"] for r in reps) == 0, reps
+            assert sum(r["ok"] for r in reps) > 0, reps
+        finally:
+            if proc2 is not None and proc2.poll() is None:
+                proc2.kill()
+                proc2.wait()
+            router.close()
+            for s in srvs:
+                s.close()

@@ -30,6 +30,15 @@ rolling-restart run shows which replica absorbed each handoff window.
 ``--blacklist FILE`` joins the fleet's shared endpoint health
 (serve/fleethealth.py): ejections propagate to/from every other client
 and the router.
+
+``--label-rate R --label-delay-s D`` switches to the FEEDBACK driver
+(``run_loadgen_feedback``) for the online-learning loop
+(docs/serving.md "Continuous learning"): every arrival is sent as
+``#score <id> <row>`` so the server logs it under a client-chosen id,
+and for a seeded fraction ``R`` of rows the client reports the row's
+own libsvm label back with ``#label <id> <y>`` after ~``D/2`` seconds —
+inside the server's ``label_delay_s`` horizon, so the join lands. The
+report adds ``labels_sent`` / ``labels_acked`` / ``labels_missed``.
 """
 
 from __future__ import annotations
@@ -168,6 +177,164 @@ def run_loadgen(host: str, port: int, rows: Sequence[Line], qps: float,
     return out
 
 
+def _row_label(row: bytes) -> float:
+    """The row's own leading libsvm label token (the ground truth the
+    feedback join replays), 0.0 when the row has none."""
+    try:
+        return float(row.split(None, 1)[0])
+    except (ValueError, IndexError):
+        return 0.0
+
+
+def run_loadgen_feedback(host: str, port: int, rows: Sequence[Line],
+                         qps: float, duration_s: float,
+                         label_delay_s: float = 0.5,
+                         label_rate: float = 0.5, seed: int = 0,
+                         recv_timeout: float = 30.0) -> dict:
+    """Open-loop driver for the serve→log→train feedback join: rows go
+    out as ``#score <id> <row>`` and a seeded ``label_rate`` fraction
+    get their own label reported back (``#label <id> <y>``) after half
+    the ``label_delay_s`` horizon — delayed, but inside the window.
+    Responses stay in request order per connection (scores resolve
+    through the batcher, label acks are raw control replies, the writer
+    drains both in admission order), so one receiver matches both."""
+    rows = [_to_bytes(r) for r in rows]
+    if not rows:
+        raise ValueError("loadgen needs at least one request row")
+    rng = np.random.RandomState(seed)
+    sock = socket.create_connection((host, port), timeout=recv_timeout)
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:  # pragma: no cover
+        pass
+    rfile = sock.makefile("rb")
+
+    # per sent line: ("score", send_t) or ("label", None), in send order
+    meta: List[tuple] = []
+    ts_lock = mutex()
+    sent = labels_sent = 0
+
+    def sender() -> None:
+        nonlocal sent, labels_sent
+        import collections
+        pending = collections.deque()   # (due_t, rid, y), due_t ascending
+        t_next = time.monotonic()
+        t_end = t_next + duration_s
+        i = 0
+        try:
+            while True:
+                now = time.monotonic()
+                # due labels first: constant delay keeps the deque sorted
+                while pending and pending[0][0] <= now:
+                    _, rid, y = pending.popleft()
+                    with ts_lock:
+                        meta.append(("label", None))
+                    sock.sendall(b"#label "
+                                 + (b"%d %g\n" % (rid, y)))
+                    labels_sent += 1
+                if now >= t_end:
+                    break
+                if now < t_next:
+                    time.sleep(min(t_next - now, 0.01))
+                    continue
+                row = rows[i % len(rows)]
+                with ts_lock:
+                    meta.append(("score", time.monotonic()))
+                sock.sendall(b"#score " + (b"%d " % i) + row)
+                sent += 1
+                if label_rate > 0 and rng.random_sample() < label_rate:
+                    pending.append((now + label_delay_s * 0.5, i,
+                                    _row_label(row)))
+                i += 1
+                t_next += rng.exponential(1.0 / qps)
+            # flush the tail of scheduled labels (their rows are already
+            # logged; an early report still joins) before half-closing
+            while pending:
+                _, rid, y = pending.popleft()
+                with ts_lock:
+                    meta.append(("label", None))
+                sock.sendall(b"#label " + (b"%d %g\n" % (rid, y)))
+                labels_sent += 1
+        except OSError:
+            # connection dropped mid-run: the receiver tallies what
+            # came back; the unsent line's meta entry is harmless (the
+            # receiver indexes by reply order and stops at EOF)
+            pass
+        try:
+            sock.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+
+    lat_ok: List[float] = []
+    n_ok = n_shed = n_err = 0
+    labels_acked = labels_missed = label_errs = 0
+
+    def receiver() -> None:
+        nonlocal n_ok, n_shed, n_err, labels_acked, labels_missed
+        nonlocal label_errs
+        i = 0
+        while True:
+            try:
+                line = rfile.readline()
+            except (socket.timeout, OSError):
+                break
+            if not line:
+                break
+            now = time.monotonic()
+            with ts_lock:
+                kind, t0 = meta[i] if i < len(meta) else ("score", None)
+            i += 1
+            if kind == "label":
+                if line.startswith(b"!err"):
+                    label_errs += 1
+                elif b"true" in line:
+                    labels_acked += 1
+                else:
+                    labels_missed += 1   # row resolved past its horizon
+            elif line.startswith(b"!shed"):
+                n_shed += 1
+            elif line.startswith(b"!err"):
+                n_err += 1
+            else:
+                n_ok += 1
+                if t0 is not None:
+                    lat_ok.append(now - t0)
+
+    st = threading.Thread(target=sender, name="loadgen-send")
+    rt = threading.Thread(target=receiver, name="loadgen-recv")
+    t_start = time.monotonic()
+    st.start()
+    rt.start()
+    st.join()
+    rt.join()
+    elapsed = time.monotonic() - t_start
+    rfile.close()
+    sock.close()
+
+    out = {
+        "target_qps": qps,
+        "duration_s": round(duration_s, 3),
+        "sent": sent,
+        "offered_qps": round(sent / max(duration_s, 1e-9), 1),
+        "ok": n_ok,
+        "shed": n_shed,
+        "err": n_err,
+        "shed_rate": round(n_shed / max(sent, 1), 4),
+        "achieved_qps": round(n_ok / max(elapsed, 1e-9), 1),
+        "labels_sent": labels_sent,
+        "labels_acked": labels_acked,
+        "labels_missed": labels_missed,
+        "label_errs": label_errs,
+    }
+    if lat_ok:
+        lat = np.asarray(lat_ok) * 1e3
+        p50, p95, p99 = np.percentile(lat, [50, 95, 99])
+        out.update(p50_ms=round(float(p50), 3), p95_ms=round(float(p95), 3),
+                   p99_ms=round(float(p99), 3),
+                   max_ms=round(float(lat.max()), 3))
+    return out
+
+
 def run_loadgen_failover(endpoints, rows: Sequence[Line], qps: float,
                          duration_s: float, seed: int = 0,
                          retries: int = 8, chunk: int = 64,
@@ -263,6 +430,12 @@ def main() -> None:
     ap.add_argument("--max-rows", type=int, default=100000,
                     help="cap on distinct rows read from --data")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--label-rate", type=float, default=0.0,
+                    help="feedback mode: report each row's own label "
+                         "back for this fraction of #score'd rows")
+    ap.add_argument("--label-delay-s", type=float, default=0.5,
+                    help="feedback mode: the server-side join horizon; "
+                         "labels go out after half of it")
     ap.add_argument("--retries", type=int, default=8,
                     help="per-endpoint retry budget (failover mode)")
     ap.add_argument("--blacklist", default="",
@@ -292,6 +465,11 @@ def main() -> None:
                   f"fails={e['fails']} ejections={e['ejections']} "
                   f"ejected={e['ejected']} active={e['active']}",
                   file=sys.stderr)
+    elif args.label_rate > 0:
+        print(json.dumps(run_loadgen_feedback(
+            args.host, args.port, rows, args.qps, args.duration,
+            label_delay_s=args.label_delay_s, label_rate=args.label_rate,
+            seed=args.seed)))
     else:
         print(json.dumps(run_loadgen(args.host, args.port, rows, args.qps,
                                      args.duration, seed=args.seed)))

@@ -106,6 +106,23 @@ def report_hists(snap: dict) -> None:
     print()
 
 
+def report_gauges(snap: dict) -> None:
+    """Instantaneous state at the final flush — in particular the
+    online-loop freshness SLO trio (train_behind_serve_s,
+    online_rows_behind, serve_generation_age_s; docs/serving.md
+    "Continuous learning")."""
+    rows = []
+    for name, series in snap.get("gauges", {}).items():
+        for key, v in series.items():
+            rows.append((f"{name}{{{key}}}" if key else name, v))
+    if not rows:
+        return
+    print("== gauges (at last flush) ==")
+    for label, v in sorted(rows):
+        print(f"  {label:54s} {v:g}")
+    print()
+
+
 def report_counters(snap: dict, top: int = 20) -> None:
     rows = []
     for name, series in snap.get("counters", {}).items():
@@ -157,6 +174,7 @@ def main() -> int:
         snap = load_last_snapshot(args.metrics)
         report_stages(snap)
         report_hists(snap)
+        report_gauges(snap)
         report_counters(snap, args.top)
     if args.trace:
         report_trace(args.trace, args.top)
