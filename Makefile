@@ -84,10 +84,11 @@ chaos:
 	$(PY) -m pytest tests/ -m chaos -q
 
 # fleet suite alone (rolling restart behind the router under load,
-# abort-on-regression legs, router peer retry, shared blacklist —
-# docs/serving.md "Fleet operations")
+# abort-on-regression legs, router peer retry, shared blacklist, the
+# router HA group + elastic autoscaler compound scenario —
+# docs/serving.md "Fleet operations", "Router HA & autoscaling")
 fleet-chaos:
-	$(PY) -m pytest tests/ -m chaos -q -k "fleet or router or rolling"
+	$(PY) -m pytest tests/ -m chaos -q -k "fleet or router or rolling or autoscale"
 
 # online-learning loop suite alone (serve→log→train→reload under
 # injected faults and a SIGKILL'd trainer — docs/serving.md
@@ -112,7 +113,7 @@ smoke:
 	__graft_entry__.dryrun_multichip(8); \
 	print('entry + dryrun ok')"
 
-ci: lint test hlomap smoke
+ci: lint test hlomap fleet-chaos smoke
 
 # human summary of a run's observability artifacts (docs/observability.md):
 #   make obs-report METRICS=run.metrics.jsonl TRACE=run.trace.json

@@ -604,6 +604,74 @@ def run_serve_bench(args) -> dict:
         succ.close()
     finally:
         server.close()
+
+    # elastic-autoscaling leg (ISSUE 18): one deliberately under-
+    # provisioned replica takes the diurnal peak behind a router while
+    # the autoscaler watches its #health — the numbers tracked are how
+    # many spawns/drains the cycle produced and how long the fleet took
+    # to settle (scale-up decision -> queue/shed back under threshold)
+    auto_spawns = auto_drains = 0
+    auto_settle_s = 0.0
+    from difacto_tpu.serve import Autoscaler, RouterServer
+    from loadgen import run_loadgen_failover
+    # slow flush cadence + small queue: the diurnal 1.6x peak visibly
+    # queues on the base (frac > up_queue_frac) while the 0.3x trough
+    # does not — the scale-up is deterministic, not a scheduler race
+    base = ServeServer(store, batch_size=args.serve_batch,
+                       max_delay_ms=50.0, queue_cap=64)
+    base.start()
+    extra: list = []
+
+    def _spawn(_idx):
+        s = ServeServer(store, batch_size=args.serve_batch,
+                        max_delay_ms=args.serve_delay_ms,
+                        queue_cap=args.serve_queue_cap)
+        s.start()
+        extra.append(s)
+        return (s.host, s.port)
+
+    router = RouterServer([(base.host, base.port)])
+    router.start()
+    scaler_t0 = _time.monotonic()
+    scaler = Autoscaler([(base.host, base.port)], _spawn,
+                        router=(router.host, router.port),
+                        min_replicas=1, max_replicas=3, poll_s=0.1,
+                        ewma=1.0, up_queue_frac=0.4, up_shed_rate=0.01,
+                        down_queue_frac=0.2, up_ticks=1, down_ticks=10,
+                        cooldown_s=0.5)
+    scaler.start()
+    try:
+        run_loadgen_failover([(router.host, router.port)], rows,
+                             qps=args.serve_qps, duration_s=4.0,
+                             profile="diurnal")
+        t_up = next((e["t"] for e in scaler.events
+                     if e["action"] == "up"), None)
+        if t_up is not None:
+            # settle: from the scale-up decision until the aggregated
+            # queue/shed signals are back under the scale-up threshold
+            deadline = _time.monotonic() + 10.0
+            while _time.monotonic() < deadline:
+                m = scaler.poll()
+                if m["queue_frac"] < 0.5 and m["shed_rate"] <= 0.01:
+                    auto_settle_s = (_time.monotonic() - scaler_t0) - t_up
+                    break
+                _time.sleep(0.05)
+        scaler.close()
+        # idle fleet: the scale-down path must walk back to min_replicas
+        end = _time.monotonic() + 3.0
+        while _time.monotonic() < end and len(scaler.endpoints()) > 1:
+            scaler.step()
+            _time.sleep(0.05)
+        auto_spawns = sum(1 for e in scaler.events
+                          if e["action"] == "up")
+        auto_drains = sum(1 for e in scaler.events
+                          if e["action"] == "down")
+    finally:
+        scaler.close()
+        router.close()
+        for s in extra:
+            s.close()
+        base.close()
     return {
         "reload_p99_ms": round(float(np.percentile(reload_ms, 99)), 3)
         if reload_ms else 0.0,
@@ -611,6 +679,9 @@ def run_serve_bench(args) -> dict:
         "bluegreen_swap_ms": round(bluegreen_ms, 3),
         "warm_parallel_ms": round(warm_parallel_ms, 3),
         "takeover_gap_ms": round(takeover_gap_ms, 3),
+        "autoscale_spawns": auto_spawns,
+        "autoscale_drains": auto_drains,
+        "autoscale_settle_s": round(auto_settle_s, 3),
         "p50_ms": rep.get("p50_ms", 0.0),
         "p95_ms": rep.get("p95_ms", 0.0),
         "p99_ms": rep.get("p99_ms", 0.0),

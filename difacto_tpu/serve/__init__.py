@@ -36,10 +36,13 @@ from dataclasses import dataclass, field
 
 from ..config import KWArgs, Param
 from ..utils.manifest import CheckpointCorrupt
+from .autoscale import Autoscaler
 from .batcher import MicroBatcher, ServeStats
 from .client import ServeClient
 from .executor import PredictExecutor, sigmoid
-from .fleet import HealthGate, run_rolling_restart, run_takeover
+from .fleet import (HealthGate, drain_endpoint, notify_backends,
+                    run_rolling_restart, run_router_group_roll,
+                    run_takeover)
 from .fleethealth import FleetHealth
 from .model import model_meta, open_serving_store, resolve_model_path
 from .reload import ModelReloader
@@ -188,4 +191,5 @@ __all__ = ["ServeParam", "run_serve", "ServeServer", "ServeClient",
            "model_meta", "open_serving_store", "resolve_model_path",
            "ModelReloader", "CheckpointCorrupt", "RouterServer",
            "FleetHealth", "HealthGate", "run_rolling_restart",
-           "run_takeover"]
+           "run_takeover", "Autoscaler", "run_router_group_roll",
+           "notify_backends", "drain_endpoint"]

@@ -95,10 +95,11 @@ class ServeClient:
         self.eject_after = eject_after
         self.reprobe_s = reprobe_s
         self.blacklist = open_blacklist(blacklist, down_s=reprobe_s)
+        self._bl_stamp = None
         # seed ejection windows from the fleet's shared discoveries and
         # start on a replica nobody has marked down — a blacklisted
         # endpoint is skipped on the FIRST connect, before any timeout
-        self._absorb_blacklist()
+        self._refresh_blacklist()
         now = time.monotonic()
         for k, ep in enumerate(self._eps):
             if ep.down_until <= now:
@@ -155,6 +156,22 @@ class ServeClient:
             if rem > 0:
                 ep.down_until = max(ep.down_until, now + rem)
 
+    def _refresh_blacklist(self) -> None:
+        """Absorb only when the shared file actually MOVED — one os.stat
+        per endpoint selection. This closes the PR 6 seed-once bug: a
+        long-lived client (the online loop's push_reload) folded the
+        blacklist at construction and on failover only, so marks written
+        after it connected never reached it; now every reconnect path
+        re-folds on a ``(mtime, size)`` change."""
+        if self.blacklist is None:
+            return
+        stamp = self.blacklist.stamp()
+        if stamp == self._bl_stamp:
+            return
+        # lint: ok(data-race) single-owner instance (see _failover)
+        self._bl_stamp = stamp
+        self._absorb_blacklist()
+
     def _deadline(self) -> Optional[float]:
         return (time.monotonic() + self.deadline_s
                 if self.deadline_s is not None else None)
@@ -205,7 +222,7 @@ class ServeClient:
                     # the shared file now skips this endpoint
                     self.blacklist.mark_down(ep.host, ep.port)
             ep.down_until = time.monotonic() + self.reprobe_s
-        self._absorb_blacklist()   # learn the fleet's discoveries too
+        self._refresh_blacklist()  # learn the fleet's discoveries too
         attempts[i] = attempts.get(i, 0) + 1
         n = len(self._eps)
         order = [(i + k) % n for k in range(1, n + 1)]  # others first
@@ -247,6 +264,20 @@ class ServeClient:
             return
         if attempts is None:
             attempts = {}
+        # a mark that arrived since we last looked side-steps the
+        # current endpoint WITHOUT burning a failure or a failover on
+        # it — the fleet already paid that discovery, we just route
+        # around it before dialing
+        self._refresh_blacklist()
+        now = time.monotonic()
+        if self._eps[self._cur].down_until > now:
+            n = len(self._eps)
+            for j in ((self._cur + k) % n for k in range(1, n)):
+                if self._eps[j].down_until <= now:
+                    # lint: ok(data-race) single-owner instance (see
+                    # _failover)
+                    self._cur = j
+                    break
         while True:
             ep = self._eps[self._cur]
             try:

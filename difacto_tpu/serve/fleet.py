@@ -36,6 +36,19 @@ tests/test_chaos.py asserts exactly that.
 CLI: ``tools/fleet.py roll`` (and ``tools/takeover.py`` remains the
 single-replica wrapper). In-process tests drive ``run_rolling_restart``
 with a ``spawn_fn`` instead of subprocess successors.
+
+Router HA (ISSUE 18) generalizes the roll to the routing tier:
+``run_router_group_roll`` replaces every member of an N-router
+SO_REUSEPORT group — members share ONE port, so the driver cannot
+address them by endpoint; instead it redials the shared port until the
+connection it HOLDS answers ``#health`` with the ``server_id`` it
+means, then sends ``#handoff`` on that held connection (established
+connections stay with their owner — the EndpointRpc invariant above).
+``notify_backends`` is the autoscaler's membership nudge: the same
+redial trick, one ``#backends add|remove`` per distinct member, with
+the router's ``endpoints_file`` re-fold as the durable backstop for a
+member the kernel's hashing never hands us. ``drain_endpoint`` (a bare
+``#handoff``) is the scale-down primitive.
 """
 
 from __future__ import annotations
@@ -98,6 +111,62 @@ def fresh_health(host: str, port: int, timeout: float = 5.0) -> dict:
         return rpc.call("#health")
     finally:
         rpc.close()
+
+
+def fresh_stats(host: str, port: int, timeout: float = 5.0) -> dict:
+    """#stats over a throwaway connection — the autoscaler's per-poll
+    read of a replica's serving counters."""
+    rpc = EndpointRpc(host, port, timeout=timeout)
+    try:
+        return rpc.call("#stats")
+    finally:
+        rpc.close()
+
+
+def drain_endpoint(host: str, port: int, timeout: float = 10.0) -> dict:
+    """Scale-down primitive: a bare ``#handoff`` (no ready file) tells
+    the replica or router to drain NOW — in-flight work finishes, fresh
+    connections land elsewhere, the process leaves its serve loop. The
+    caller removes the endpoint from the routing ring first
+    (:func:`notify_backends`), so the drain window sheds nothing."""
+    rpc = EndpointRpc(host, port, timeout=timeout)
+    try:
+        return rpc.call("#handoff")
+    finally:
+        rpc.close()
+
+
+def notify_backends(host: str, port: int, op: str, target: str,
+                    max_dials: int = 16, settle: int = 4,
+                    timeout: float = 5.0) -> dict:
+    """Tell every member of a router group about a ring change:
+    ``#backends <op> <target>`` (op ``add``/``remove``, target
+    ``host:port``). Fresh connections hash across the SO_REUSEPORT
+    group, so dial until ``settle`` consecutive dials reach only
+    already-acked members; the op is idempotent per member. Best-effort
+    by design — the routers' ``endpoints_file`` re-fold is the durable
+    channel, this is the low-latency nudge."""
+    acks: Dict[str, dict] = {}
+    misses = dials = 0
+    line = f"#backends {op} {target}".strip()
+    while dials < max_dials and misses < settle:
+        dials += 1
+        try:
+            rpc = EndpointRpc(host, port, timeout=timeout)
+            try:
+                r = rpc.call(line)
+            finally:
+                rpc.close()
+        except (OSError, ConnectionError, ValueError):
+            misses += 1
+            continue
+        sid = str(r.get("server_id", f"dial-{dials}"))
+        if sid in acks:
+            misses += 1
+        else:
+            misses = 0
+            acks[sid] = r
+    return {"ok": bool(acks), "routers": acks}
 
 
 class HealthGate:
@@ -373,3 +442,171 @@ def run_rolling_restart(
                  "(warm %.1fs)", i + 1, len(eps), host, port,
                  h["server_id"], warm_s)
     return {"ok": True, "replicas": completed}
+
+
+# ------------------------------------------- router group roll (ISSUE 18)
+
+def spawn_router(endpoints: str, port: int, ready_file: str, extra=(),
+                 host: str = "127.0.0.1") -> "subprocess.Popen":
+    """Default router successor: ``tools/fleet.py route --takeover`` on
+    the shared group port, ready-file signaled, log next to the ready
+    file (same detachment rules as :func:`spawn_successor`)."""
+    args = [sys.executable, os.path.join(REPO, "tools", "fleet.py"),
+            "route", "--host", host, "--port", str(port),
+            "--endpoints", endpoints, "--takeover",
+            "--ready-file", ready_file, *extra]
+    logf = open(ready_file + ".log", "ab")
+    try:
+        return subprocess.Popen(args, cwd=REPO, stdin=subprocess.DEVNULL,
+                                stdout=logf, stderr=logf,
+                                start_new_session=True)
+    finally:
+        logf.close()   # the child holds its own descriptor
+
+
+def _dial_member(host: str, port: int, want: Optional[str] = None,
+                 avoid=(), max_dials: int = 32,
+                 timeout: float = 5.0):
+    """Hold a connection to a SPECIFIC member of a SO_REUSEPORT router
+    group. Fresh connections hash over the group, so redial until the
+    connection we HOLD answers ``#health`` with the ``server_id`` we
+    mean (``want``), or with any id not in ``avoid`` (``want=None``).
+    Returns ``(rpc, health)`` — the caller owns the rpc — or
+    ``(None, None)`` after ``max_dials``."""
+    for _ in range(max_dials):
+        try:
+            rpc = EndpointRpc(host, port, timeout=timeout)
+        except OSError:
+            time.sleep(0.05)
+            continue
+        try:
+            h = rpc.call("#health")
+        except (OSError, ConnectionError, ValueError):
+            rpc.close()
+            time.sleep(0.05)
+            continue
+        sid = h.get("server_id")
+        if sid == want or (want is None and sid not in avoid):
+            return rpc, h
+        rpc.close()
+    return None, None
+
+
+def _discover_group(host: str, port: int, group_size: int,
+                    max_dials: int, timeout: float = 5.0) -> Dict[str, dict]:
+    """Enumerate a router group's members by server_id: dial the shared
+    port until ``group_size`` distinct ids answered (or the dial budget
+    ran out — the caller decides whether a partial census aborts)."""
+    seen: Dict[str, dict] = {}
+    for _ in range(max_dials):
+        if len(seen) >= group_size:
+            break
+        try:
+            h = fresh_health(host, port, timeout=timeout)
+        except (OSError, ConnectionError, ValueError):
+            time.sleep(0.05)
+            continue
+        sid = h.get("server_id")
+        if sid:
+            seen[str(sid)] = h
+    return seen
+
+
+def run_router_group_roll(
+        host: str, port: int, group_size: int,
+        spawn_fn: Optional[Callable] = None, endpoints: str = "",
+        extra=(), wait_s: float = 180.0, poll_s: float = 0.05,
+        max_dials: int = 64) -> dict:
+    """Roll every member of an N-router SO_REUSEPORT group, one at a
+    time, with zero client-visible errors — the routing-tier analog of
+    :func:`run_rolling_restart`, reusing its ready-file/handoff
+    sequencing with one twist: group members share ONE port, so each
+    step (a) spawns the successor and waits for its ready file, (b)
+    learns the successor's server_id (the first NEW id fresh dials
+    reach), (c) redials until it holds a connection to the incumbent it
+    means and sends ``#handoff <ready_file>`` there (the router refuses
+    a handoff naming its own ready file, so a misrouted dial is caught
+    even if the census raced), then (d) polls fresh connections until
+    the incumbent has left the group. ``spawn_fn(i, host, port,
+    ready_file)`` overrides the subprocess successor for in-process
+    tests; the default spawns ``tools/fleet.py route`` with
+    ``endpoints``/``extra``."""
+    census = _discover_group(host, port, group_size, max_dials)
+    if len(census) < group_size:
+        return {"ok": False, "aborted_at": 0,
+                "reason": (f"discovered {len(census)} of {group_size} "
+                           "group members"), "completed": []}
+    completed: List[dict] = []
+    known = set(census)
+
+    def abort(i: int, sid: str, reason: str) -> dict:
+        log.warning("router group roll ABORTED at member %d (%s): %s",
+                    i, sid, reason)
+        return {"ok": False, "aborted_at": i, "incumbent": sid,
+                "reason": reason, "completed": completed}
+
+    for i, sid in enumerate(list(census)):
+        fd, ready_file = tempfile.mkstemp(suffix=".ready")
+        os.close(fd)
+        os.unlink(ready_file)
+        proc = (spawn_fn(i, host, port, ready_file)
+                if spawn_fn is not None
+                else spawn_router(endpoints, port, ready_file, extra,
+                                  host=host))
+        try:
+            warm_s = _wait_ready_file(ready_file, proc, wait_s, poll_s)
+        except (RuntimeError, TimeoutError) as e:
+            if proc is not None and hasattr(proc, "terminate"):
+                try:
+                    proc.terminate()
+                except OSError:  # pragma: no cover
+                    pass
+            return abort(i, sid, f"successor ready-file: {e}")
+        succ_rpc, succ_h = _dial_member(host, port, avoid=known,
+                                        max_dials=max_dials)
+        if succ_rpc is None:
+            return abort(i, sid,
+                         "successor wrote its ready file but never "
+                         "answered a fresh dial")
+        succ_id = str(succ_h.get("server_id"))
+        succ_rpc.close()
+        known.add(succ_id)
+        rpc, _h = _dial_member(host, port, want=sid,
+                               max_dials=max_dials)
+        if rpc is None:
+            return abort(i, sid, "could not re-reach the incumbent "
+                         "on the shared port")
+        try:
+            res = rpc.call(f"#handoff {ready_file}")
+        except (OSError, ConnectionError, ValueError) as e:
+            return abort(i, sid, f"handoff failed: {e}")
+        finally:
+            rpc.close()
+        # (d) the incumbent leaves: fresh dials stop reaching its id
+        # (probabilistic under kernel hashing, so count consecutive
+        # non-sightings, bounded by the wait budget)
+        t0 = time.monotonic()
+        gone_after = 2 * group_size + 4
+        gone = 0
+        while gone < gone_after:
+            if time.monotonic() - t0 > wait_s:
+                return abort(i, sid,
+                             "incumbent still answering fresh "
+                             "connections after handoff")
+            try:
+                h = fresh_health(host, port)
+            except (OSError, ConnectionError, ValueError):
+                time.sleep(poll_s)
+                continue
+            if h.get("server_id") == sid \
+                    and h.get("status") != "draining":
+                gone = 0
+                time.sleep(poll_s)
+            else:
+                gone += 1
+        completed.append({"incumbent": sid, "successor": succ_id,
+                          "warm_s": round(warm_s, 3), "handoff": res})
+        log.info("router group roll: member %d/%d %s -> %s "
+                 "(warm %.1fs)", i + 1, group_size, sid, succ_id,
+                 warm_s)
+    return {"ok": True, "routers": completed}

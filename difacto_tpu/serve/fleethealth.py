@@ -212,6 +212,17 @@ class FleetHealth:
                 out[ep] = remaining
         return out
 
+    def stamp(self) -> Optional[Tuple[float, int]]:
+        """The file's current ``(mtime, size)`` — one os.stat, no read.
+        Long-lived holders (ServeClient) compare stamps per endpoint
+        selection and re-fold only on change, so marks written AFTER
+        they connected still reach them (the PR 6 seed-once bug)."""
+        try:
+            st = os.stat(self.path)
+            return (st.st_mtime, st.st_size)
+        except OSError:
+            return None
+
     def down_remaining(self, host: str, port: int) -> float:
         """Seconds the endpoint stays suppressed (0.0 = not down)."""
         return self.down_endpoints().get(_key(host, port), 0.0)

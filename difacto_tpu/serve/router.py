@@ -43,6 +43,32 @@ with the rows around them.
 ``router.forward`` is a chaos injection point in the forward path
 (utils/faultinject.py): ``err``/``close`` model a backend failing
 mid-chunk and must surface as a peer retry, not a client error.
+
+Router HA (ISSUE 18): with ``takeover=True`` the listener binds
+``SO_REUSEPORT``, so N router processes share ONE advertised port — the
+kernel spreads fresh client connections across the group, every member
+folds the same ``FleetHealth`` blacklist, and one member dying loses
+only the connections it held (clients fail over and reconnect onto a
+surviving member). Routers roll like replicas: ``#handoff
+[ready_file]`` waits for the successor's ready file, then ``drain()``
+stops accepting (fresh connections shift to the group), finishes the
+chunk in flight on every held connection and closes at a line boundary
+— a clean EOF the failover client answers by resending its unanswered
+tail elsewhere. ``router.takeover`` is the chaos point on that path.
+
+Balance policies: ``balance="p2c"`` (default, above) or
+``balance="affinity"`` — consistent-hash rows by their leading feature
+key so a key's requests pin to one replica's warm cache, mirroring the
+store's ``hash_slots`` + ``fs_shard_bounds`` arithmetic when
+``affinity_capacity`` is set (the replica whose fs-shard owns the key
+serves it). The owner being ejected/draining falls back to p2c —
+affinity is cache placement, never correctness (every replica serves
+the full model, so routed scores stay byte-identical regardless).
+
+Elastic membership: ``#backends [add|remove host:port]`` adjusts the
+ring at runtime (the autoscaler's nudge), and an ``endpoints_file``
+re-folds on ``(mtime, size)`` change — durable membership a relaunched
+router recovers without having seen the nudges.
 """
 
 from __future__ import annotations
@@ -55,6 +81,7 @@ import random
 import socket
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 from ..config import parse_endpoints
@@ -71,7 +98,7 @@ class _Backend:
     connections never interleave on one backend socket)."""
 
     __slots__ = ("host", "port", "in_flight", "ewma_ms", "fails",
-                 "down_until", "rows", "ejections")
+                 "down_until", "rows", "ejections", "removed")
 
     def __init__(self, host: str, port: int):
         self.host, self.port = host, int(port)
@@ -81,6 +108,7 @@ class _Backend:
         self.down_until = 0.0   # monotonic ejection deadline
         self.rows = 0           # rows answered by this backend
         self.ejections = 0
+        self.removed = False    # tombstone (indices stay stable)
 
     @property
     def key(self) -> str:
@@ -92,10 +120,17 @@ class RouterServer:
                  chunk: int = 64, retries: int = 2, eject_after: int = 3,
                  reprobe_s: float = 5.0, blacklist=None,
                  timeout: float = 30.0, probe_timeout: float = 2.0,
-                 drain_eject_s: float = 1.0):
+                 drain_eject_s: float = 1.0, takeover: bool = False,
+                 ready_file: str = "", handoff_wait_s: float = 30.0,
+                 balance: str = "p2c", affinity_capacity: int = 0,
+                 endpoints_file: str = ""):
         from ..obs import Registry
-        self._backends = [_Backend(h, p)
-                          for h, p in parse_endpoints(endpoints)]
+        if balance not in ("p2c", "affinity"):
+            raise ValueError(f"unknown balance policy {balance!r} "
+                             "(want p2c or affinity)")
+        self._backends = ([_Backend(h, p)
+                           for h, p in parse_endpoints(endpoints)]
+                          if endpoints else [])
         self.chunk = chunk
         self.retries = retries
         self.eject_after = eject_after
@@ -103,6 +138,12 @@ class RouterServer:
         self.timeout = timeout
         self.probe_timeout = probe_timeout
         self.drain_eject_s = drain_eject_s
+        self.takeover = bool(takeover)
+        self.ready_file = ready_file
+        self.handoff_wait_s = handoff_wait_s
+        self.balance = balance
+        self.affinity_capacity = int(affinity_capacity)
+        self.endpoints_file = endpoints_file
         self.blacklist = open_blacklist(blacklist, down_s=reprobe_s)
         self._rng = random.Random(0x20072)
         self.obs = Registry(enabled=True)
@@ -117,22 +158,43 @@ class RouterServer:
             "rows answered !shed because no backend was available")
         self._err_c = self.obs.counter(
             "router_errors_total", "rows rejected at the router")
-        self._mu = mutex()               # backend stats
-        self._sock = socket.create_server((host, port))
+        self._aff_hit_c = self.obs.counter(
+            "router_affinity_hits_total",
+            "affinity forwards that landed on the ring owner")
+        self._aff_miss_c = self.obs.counter(
+            "router_affinity_misses_total",
+            "affinity forwards diverted off the owner (ejected/draining)")
+        self._mu = mutex()               # backend stats + membership
+        self._eps_stamp: Optional[tuple] = None
+        self._eps_next_poll = 0.0
+        # SO_REUSEPORT group bind: N routers share this port; fresh
+        # connections hash across whichever members still listen
+        self._sock = socket.create_server((host, port),
+                                          reuse_port=takeover)
         self._sock.settimeout(0.25)
         self.host, self.port = self._sock.getsockname()[:2]
         self._alive = False
         self._closed = False
+        self._draining = False
+        self.successor_ready = False
+        self._successor_file: Optional[str] = None
+        self._handoff_thread: Optional[threading.Thread] = None
         self._done = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: set = set()
         self._conn_threads: list = []
-        self._cmu = mutex()              # connection bookkeeping
+        self._cmu = mutex()              # connection + handoff state
+        self._refresh_endpoints(force=True)
+        if not self._backends:
+            raise ValueError(
+                "router needs endpoints (inline or endpoints_file)")
 
     # ---------------------------------------------------------- control
     def start(self) -> "RouterServer":
         # lint: ok(data-race) monotonic stop flag; accept loop re-checks
         self._alive = True
+        # lint: ok(data-race) written once in start(); drain() only runs
+        # after start() returned (callers hold the instance)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="router-accept", daemon=True)
         self._accept_thread.start()
@@ -142,6 +204,32 @@ class RouterServer:
 
     def wait(self, timeout: Optional[float] = None) -> None:
         self._done.wait(timeout)
+
+    def drain(self) -> None:
+        """Leave the SO_REUSEPORT group gracefully: close the listener
+        (the kernel shifts fresh connections onto the surviving
+        members), finish the chunk in flight on every held connection,
+        and close each at a line boundary — the failover client sees a
+        clean EOF and resends its unanswered tail on a reconnect that
+        lands on a group peer."""
+        with self._cmu:
+            if self._draining or self._closed:
+                return
+            self._draining = True
+        self._alive = False
+        try:
+            self._sock.close()
+        except OSError:  # pragma: no cover
+            pass
+        t = self._accept_thread
+        if t is not None:
+            t.join()
+            self._accept_thread = None
+        with self._cmu:
+            threads = list(self._conn_threads)
+        for t in threads:
+            t.join()
+        self.close()
 
     def close(self) -> None:
         with self._cmu:
@@ -220,10 +308,19 @@ class RouterServer:
                               daemon=True)
         rt.start()
         pool: Dict[int, Tuple[socket.socket, object]] = {}
+        forward = (self._forward_affinity if self.balance == "affinity"
+                   else self._forward)
         try:
             eof = False
             while not eof:
-                item = q.get()
+                try:
+                    item = q.get(timeout=0.25)
+                except queue.Empty:
+                    # idle moment: a draining router leaves here — the
+                    # connection closes at a line boundary, nothing owed
+                    if self._drain_pending():
+                        break
+                    continue
                 if item is None:
                     break
                 if item.startswith(b"#"):
@@ -245,9 +342,13 @@ class RouterServer:
                         carry = nxt
                         break
                     rows.append(nxt)
-                conn.sendall(b"".join(self._forward(rows, pool)))
+                conn.sendall(b"".join(forward(rows, pool)))
                 if carry is not None:
                     conn.sendall(self._control(carry))
+                if self._drain_pending():
+                    # the chunk in flight was answered; a pipelining
+                    # client never pins a draining router past one chunk
+                    break
         except OSError:   # client went away mid-reply
             pass
         finally:
@@ -258,12 +359,22 @@ class RouterServer:
                 except OSError:
                     pass
             try:
+                # shutdown (not just close) so the blocked reader thread
+                # wakes with EOF when WE end the connection (drain path)
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 conn.close()
             except OSError:
                 pass
             with self._cmu:
                 self._conns.discard(conn)
             rt.join()
+
+    def _drain_pending(self) -> bool:
+        with self._cmu:
+            return self._draining or self._closed
 
     # -------------------------------------------------------- balancing
     def _refresh_blacklist(self) -> None:
@@ -281,20 +392,93 @@ class RouterServer:
                 if rem > 0:
                     b.down_until = max(b.down_until, now + rem)
 
-    def _pick(self, attempts: Dict[int, int]) -> Optional[int]:
+    # ------------------------------------------------------- membership
+    def _live_backends(self) -> List[_Backend]:
+        with self._mu:
+            return [b for b in self._backends if not b.removed]
+
+    def _add_backend(self, host: str, port: int) -> None:
+        """Join (or un-tombstone) an endpoint. The backend list is
+        append-only — indices held by in-flight forwards stay valid."""
+        key = f"{host}:{int(port)}"
+        with self._mu:
+            for b in self._backends:
+                if b.key == key:
+                    b.removed = False
+                    return
+            self._backends.append(_Backend(host, port))
+        log.info("router: backend %s joined the ring", key)
+
+    def _remove_backend(self, host: str, port: int) -> None:
+        key = f"{host}:{int(port)}"
+        with self._mu:
+            for b in self._backends:
+                if b.key == key and not b.removed:
+                    b.removed = True
+                    log.info("router: backend %s left the ring", key)
+
+    def _refresh_endpoints(self, force: bool = False) -> None:
+        """Durable group membership: when an ``endpoints_file`` is
+        configured, a ``(mtime, size)`` change re-folds the file into
+        the backend ring (one ``host:port`` per whitespace-separated
+        token) — a relaunched router recovers autoscaler decisions it
+        never saw as ``#backends`` nudges. One os.stat per check,
+        throttled to ~2/s off the hot path."""
+        if not self.endpoints_file:
+            return
+        now = time.monotonic()
+        with self._mu:
+            if not force and now < self._eps_next_poll:
+                return
+            self._eps_next_poll = now + 0.5
+        try:
+            st = os.stat(self.endpoints_file)
+        except OSError:
+            return
+        stamp = (st.st_mtime, st.st_size)
+        with self._mu:
+            if stamp == self._eps_stamp:
+                return
+            self._eps_stamp = stamp
+        try:
+            with open(self.endpoints_file) as f:
+                toks = [t for t in f.read().split() if t]
+            eps = parse_endpoints(",".join(toks)) if toks else []
+        except (OSError, ValueError) as e:
+            log.warning("router: unreadable endpoints file %s (%s)",
+                        self.endpoints_file, e)
+            return
+        want = {f"{h}:{int(p)}" for h, p in eps}
+        for h, p in eps:
+            self._add_backend(h, p)
+        with self._mu:
+            stale = [b for b in self._backends
+                     if b.key not in want and not b.removed]
+            for b in stale:
+                b.removed = True
+
+    def _pick(self, attempts: Dict[int, int],
+              prefer: Optional[int] = None) -> Optional[int]:
         """Power-of-two-choices over live backends still inside this
         forward's retry budget; all-ejected falls back to the least-
         recently-ejected (the router never deadlocks itself into "no
-        replicas" while one might answer). None = budget exhausted."""
+        replicas" while one might answer). None = budget exhausted.
+        ``prefer`` (affinity owner) wins while it is live and untried —
+        after its first failure the pick degrades to plain p2c."""
+        self._refresh_endpoints()
         self._refresh_blacklist()
-        cands = [i for i in range(len(self._backends))
-                 if attempts.get(i, 0) <= self.retries]
-        if not cands:
-            return None
         now = time.monotonic()
         with self._mu:
+            cands = [i for i in range(len(self._backends))
+                     if not self._backends[i].removed
+                     and attempts.get(i, 0) <= self.retries]
+            if not cands:
+                return None
             live = [i for i in cands
                     if self._backends[i].down_until <= now]
+            if prefer is not None and prefer in live \
+                    and attempts.get(prefer, 0) == 0:
+                return prefer
             if not live:
                 return min(cands,
                            key=lambda i: self._backends[i].down_until)
@@ -386,9 +570,69 @@ class RouterServer:
             out[k] = line
         return out
 
+    # --------------------------------------------------------- affinity
+    def _affinity_key(self, row: bytes) -> int:
+        """Consistent-hash key of a libsvm row (``label idx:val ...``):
+        its leading feature index. Per-key/per-user request streams put
+        the identifying feature first, so the whole stream pins to one
+        replica's warm cache and fs-shard."""
+        parts = row.split(None, 2)
+        if len(parts) < 2:
+            return 0
+        tok = parts[1].split(b":", 1)[0]
+        try:
+            return int(tok)
+        except ValueError:
+            return zlib.crc32(tok)
+
+    def _affinity_owner(self, row: bytes, ring: List[int]) -> int:
+        """Backend index that owns the row's key. With
+        ``affinity_capacity`` set this mirrors the store's hashed-slot
+        plus contiguous-range arithmetic (store/local.py ``hash_slots``,
+        parallel/mesh.py ``fs_shard_bounds``): slot = key %% (cap-1) + 1
+        and shard i owns slots [i*cap/n, (i+1)*cap/n) — the row lands on
+        the replica whose fs-shard holds its leading key. capacity=0
+        hashes the key straight onto the ring (splitmix64 finalizer, so
+        adjacent integer keys spread)."""
+        n = len(ring)
+        key = self._affinity_key(row)
+        cap = self.affinity_capacity
+        if cap > 1:
+            slot = key % (cap - 1) + 1
+            return ring[min(slot * n // cap, n - 1)]
+        z = (key + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+        return ring[(z ^ (z >> 31)) % n]
+
+    def _forward_affinity(self, rows: List[bytes],
+                          pool: dict) -> List[bytes]:
+        """Partition the chunk by ring owner, forward each partition
+        with its owner preferred, splice responses back into arrival
+        order (positions are exact — ``_forward`` answers one line per
+        row, always). Owner ejected/draining degrades that partition to
+        p2c (counted as affinity misses), never to an error."""
+        with self._mu:
+            ring = [i for i, b in enumerate(self._backends)
+                    if not b.removed]
+        if not ring:
+            return self._forward(rows, pool)
+        groups: Dict[int, List[int]] = {}
+        for k, r in enumerate(rows):
+            groups.setdefault(self._affinity_owner(r, ring),
+                              []).append(k)
+        out: List[bytes] = [b""] * len(rows)
+        for owner, ks in sorted(groups.items()):
+            sub = self._forward([rows[k] for k in ks], pool,
+                                prefer=owner)
+            for k, resp in zip(ks, sub):
+                out[k] = resp
+        return out
+
     # ---------------------------------------------------------- forward
     def _forward(self, rows: List[bytes], pool: dict,
-                 _retry_shed: bool = True) -> List[bytes]:
+                 _retry_shed: bool = True,
+                 prefer: Optional[int] = None) -> List[bytes]:
         """Forward one chunk; returns one newline-terminated response
         line per row, in order. Backend failures resend the unanswered
         tail on a peer; exhausting every backend's budget answers the
@@ -397,8 +641,13 @@ class RouterServer:
         pending = [r + b"\n" for r in rows]
         out: List[bytes] = []
         attempts: Dict[int, int] = {}
+        first_pick = prefer is not None
         while pending:
-            i = self._pick(attempts)
+            i = self._pick(attempts, prefer)
+            if first_pick and i is not None:
+                (self._aff_hit_c if i == prefer
+                 else self._aff_miss_c).inc(len(pending))
+                first_pick = False
             if i is None:
                 self._shed_c.inc(len(pending))
                 out.extend([b"!shed router: no backend available\n"]
@@ -484,15 +733,18 @@ class RouterServer:
                      "ewma_ms": round(b.ewma_ms, 3), "fails": b.fails,
                      "ejected": b.down_until > now, "rows": b.rows,
                      "ejections": b.ejections}
-                    for b in self._backends]
+                    for b in self._backends if not b.removed]
 
     def health_snapshot(self) -> dict:
         """Fleet-wide #health: ready while ANY replica is ready (that is
         what a router buys you), per-replica payloads attached so one
-        poll shows which replica is the problem."""
+        poll shows which replica is the problem. ``server_id`` names
+        WHICH group member answered — the roll driver dials the shared
+        port until it holds a connection to the member it means."""
         replicas = []
         ready = queue_depth = 0
-        for b in self._backends:
+        live = self._live_backends()
+        for b in live:
             try:
                 h = self._probe_json(b, b"#health")
             except (OSError, ConnectionError, ValueError) as e:
@@ -502,20 +754,30 @@ class RouterServer:
             if h.get("status") == "ready":
                 ready += 1
             queue_depth += int(h.get("queue_depth", 0))
-        return {"status": "ready" if ready else "down",
-                "router": True, "pid": os.getpid(),
-                "server_id": f"router.{os.getpid()}.{id(self):x}",
-                "replicas_live": ready,
-                "replicas_total": len(self._backends),
-                "queue_depth": queue_depth,
-                "replicas": replicas}
+        with self._cmu:
+            draining = self._draining
+            successor_file = self._successor_file
+            successor_ready = self.successor_ready
+        out = {"status": ("draining" if draining
+                          else "ready" if ready else "down"),
+               "router": True, "pid": os.getpid(),
+               "server_id": f"router.{os.getpid()}.{id(self):x}",
+               "takeover": self.takeover,
+               "balance": self.balance,
+               "replicas_live": ready,
+               "replicas_total": len(live),
+               "queue_depth": queue_depth,
+               "replicas": replicas}
+        if successor_file is not None:
+            out["successor_ready"] = successor_ready
+        return out
 
     def stats_snapshot(self) -> dict:
         """Router counters + balance state + the fleet's summed serving
         counters (each replica's #stats, best-effort)."""
         fleet: Dict[str, float] = {}
         replicas = []
-        for b in self._backends:
+        for b in self._live_backends():
             try:
                 st = self._probe_json(b, b"#stats")
             except (OSError, ConnectionError, ValueError) as e:
@@ -530,9 +792,12 @@ class RouterServer:
             rows = sum(b.rows for b in self._backends)
         return {"router": True,
                 "rows": rows,
+                "balance": self.balance,
                 "retries": int(self._retry_c.value()),
                 "shed": int(self._shed_c.value()),
                 "errors": int(self._err_c.value()),
+                "affinity_hits": int(self._aff_hit_c.value()),
+                "affinity_misses": int(self._aff_miss_c.value()),
                 "backends": self.backends_snapshot(),
                 "fleet": fleet, "replicas": replicas}
 
@@ -550,12 +815,101 @@ class RouterServer:
                               "recent per-row backend latency (EWMA)")
         with self._mu:
             for b in self._backends:
+                if b.removed:
+                    continue
                 up.labels(endpoint=b.key).set(
                     0.0 if b.down_until > now else 1.0)
                 infl.labels(endpoint=b.key).set(b.in_flight)
                 ewma.labels(endpoint=b.key).set(b.ewma_ms)
+        hits = self._aff_hit_c.value()
+        misses = self._aff_miss_c.value()
+        self.obs.gauge(
+            "router_affinity_hit_rate",
+            "fraction of affinity forwards landing on the ring owner"
+        ).set(hits / (hits + misses) if (hits + misses) else 0.0)
         snap = merge_into(self.obs.snapshot(), REGISTRY.snapshot())
         return render_prometheus(snap)
+
+    # ----------------------------------------------------- handoff roll
+    def _control_handoff(self, line: bytes) -> bytes:
+        """``#handoff [ready_file]``: acknowledge, then wait for the
+        successor's ready file and drain out of the SO_REUSEPORT group
+        on a BACKGROUND thread — drain joins connection threads, so it
+        must never run on the requesting connection's own thread.
+        ``router.takeover`` is the chaos point: an injected err refuses
+        the roll before any state changes."""
+        try:
+            faultinject.act_default(faultinject.fire("router.takeover"))
+        except faultinject.FaultInjected as e:
+            self._err_c.inc()
+            return b"!err %s\n" % str(e).encode()
+        arg = line[len(b"#handoff"):].strip().decode()
+        if arg and self.ready_file and \
+                os.path.abspath(arg) == os.path.abspath(self.ready_file):
+            # the group port hashed this connection to the successor:
+            # the named ready file is OUR OWN — refuse, the roll driver
+            # redials until it holds a connection to the incumbent
+            return (b"!err handoff addressed to the successor "
+                    b"(this router owns the ready file)\n")
+        with self._cmu:
+            if self._handoff_thread is not None:
+                return (json.dumps({"ok": True, "state": "draining"})
+                        + "\n").encode()
+            self._successor_file = arg
+            t = threading.Thread(target=self._handoff, args=(arg,),
+                                 name="router-handoff", daemon=True)
+            self._handoff_thread = t
+        t.start()
+        return (json.dumps({"ok": True, "state": "handoff",
+                            "successor_file": arg}) + "\n").encode()
+
+    def _handoff(self, ready_file: str) -> None:
+        """Wait (bounded by ``handoff_wait_s``) for the successor's
+        ready file, then drain. An empty ready_file drains immediately —
+        the autoscaler's scale-down primitive. A successor that never
+        appears does not pin the incumbent: the handoff was an explicit
+        operator request to leave, so after the budget we drain anyway —
+        loudly."""
+        ready = True
+        if ready_file:
+            end = time.monotonic() + self.handoff_wait_s
+            while (not os.path.isfile(ready_file)
+                   and time.monotonic() < end
+                   and not self._drain_pending()):
+                time.sleep(0.05)
+            ready = os.path.isfile(ready_file)
+            if not ready and not self._drain_pending():
+                log.warning("router handoff: successor never became "
+                            "ready (%s); draining anyway", ready_file)
+        with self._cmu:
+            self.successor_ready = ready
+        log.info("router handoff: draining (successor_ready=%s)", ready)
+        self.drain()
+
+    def _control_backends(self, line: bytes) -> bytes:
+        """``#backends [add|remove host:port]``: runtime ring
+        membership — the autoscaler's nudge to every group member. A
+        bare ``#backends`` just lists the live ring."""
+        arg = line[len(b"#backends"):].strip().decode()
+        if arg:
+            parts = arg.split()
+            if len(parts) != 2 or parts[0] not in ("add", "remove"):
+                self._err_c.inc()
+                return b"!err router: want add|remove host:port\n"
+            try:
+                host, port = parse_endpoints(parts[1])[0]
+            except ValueError as e:
+                self._err_c.inc()
+                return b"!err router: %s\n" % str(e).encode()
+            if parts[0] == "add":
+                self._add_backend(host, port)
+            else:
+                self._remove_backend(host, port)
+        return (json.dumps(
+            {"ok": True,
+             "server_id": f"router.{os.getpid()}.{id(self):x}",
+             "backends": [b.key for b in self._live_backends()]})
+            + "\n").encode()
 
     def _control(self, line: bytes) -> bytes:
         if line == b"#health":
@@ -566,5 +920,9 @@ class RouterServer:
             # multi-line payload, blank-line terminated (server.py
             # contract — ServeClient.metrics() works unchanged)
             return self.metrics_text().encode() + b"\n"
+        if line == b"#handoff" or line.startswith(b"#handoff "):
+            return self._control_handoff(line)
+        if line == b"#backends" or line.startswith(b"#backends "):
+            return self._control_backends(line)
         self._err_c.inc()
         return b"!err router: unsupported control %s\n" % line[:32]

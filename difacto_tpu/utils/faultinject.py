@@ -42,7 +42,14 @@ run of a real cluster) arm through one environment variable:
   surfaces as a typed ``!err`` reply to the reporting client, the
   connection stays up), ``online.seal`` (committing a full segment —
   ``err`` keeps the resolved buffer in memory and retries on the next
-  advance, so a transient seal failure never loses rows).
+  advance, so a transient seal failure never loses rows),
+  ``router.takeover`` (the router's ``#handoff`` roll-out-of-the-group
+  path, serve/router.py — ``err`` refuses the roll before any state
+  changes, the incumbent keeps routing and the group keeps serving),
+  ``autoscale.spawn`` (the autoscaler's scale-up decision,
+  serve/autoscale.py — ``err`` models the spawn path failing: no
+  binary, no free port, quota; the decision is refused and counted in
+  ``autoscale_aborts_total`` while the control loop keeps measuring).
 - ``kind`` — what happens when the fault fires:
     - ``err``      raise :class:`FaultInjected` (an OSError, so IO call
                    sites treat it exactly like a real IO failure);

@@ -97,6 +97,12 @@ def _relaunch_delay(attempt: int, hb_timeout: float,
     return max(hb_timeout, base * (0.5 + rng.random()))
 
 
+# public alias: the router-group supervisor (tools/fleet.py routers)
+# relaunches dead group members on the same schedule the multi-host
+# launcher uses — one backoff policy for the whole system
+relaunch_delay = _relaunch_delay
+
+
 def _read_hostfile(path: str) -> list:
     hosts = []
     with open(path) as f:

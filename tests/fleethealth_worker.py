@@ -6,6 +6,12 @@ not a jax import. The module is deliberately dependency-free (stdlib
 only) precisely so other tools can do the same.
 
 Usage: fleethealth_worker.py <fleethealth.py> <blacklist> <tag> <n>
+           [max_bytes]
+
+``max_bytes`` (default: effectively unbounded) arms the in-place
+compaction path: a small value makes every writer compact the shared
+file many times while its peers append — the race the N-router-group
+test drives.
 """
 
 import importlib.util
@@ -21,8 +27,9 @@ def load_module(path):
 
 if __name__ == "__main__":
     module_path, bl_path, tag, n = sys.argv[1:5]
+    max_bytes = int(sys.argv[5]) if len(sys.argv) > 5 else 1 << 30
     fh = load_module(module_path).FleetHealth(
-        bl_path, down_s=60.0, max_bytes=1 << 30)
+        bl_path, down_s=60.0, max_bytes=max_bytes)
     for k in range(int(n)):
         # alternate down/clear over a small endpoint set: maximal
         # contention on the same file, interleaved with the other writer

@@ -1308,3 +1308,308 @@ def test_push_stale_fault_fires_typed(rcv1_path, tmp_path):
         "fault never fired — the windowed schedule never posted a clock"
     assert REGISTRY.value("faults_fired_total", point="push.stale",
                           kind="err") > before
+
+
+# --------------------- router HA group + elastic autoscaling (ISSUE 18)
+
+def _router_or_skip(endpoints, **kw):
+    from difacto_tpu.serve import RouterServer
+    try:
+        return RouterServer(endpoints, **kw).start()
+    except OSError as e:  # pragma: no cover - loaded/locked-down CI box
+        pytest.skip(f"cannot bind a router port: {e}")
+
+
+def _router_group(endpoints, n=2, **kw):
+    """n in-process routers sharing ONE SO_REUSEPORT port. Returns the
+    list of RouterServer instances (element 0 owns the advertised
+    port)."""
+    first = _router_or_skip(endpoints, takeover=True, **kw)
+    group = [first]
+    try:
+        for _ in range(n - 1):
+            group.append(_router_or_skip(
+                endpoints, host=first.host, port=first.port,
+                takeover=True, **kw))
+    except BaseException:
+        _close_fleet(group)
+        raise
+    return group
+
+
+def _inproc_router_spawn(endpoints, group):
+    """spawn_fn for run_router_group_roll: an in-process successor
+    router on the shared port, registered in ``group`` for teardown."""
+    from difacto_tpu.serve import RouterServer
+
+    def spawn(i, host, port, ready_file):
+        r = RouterServer(endpoints, host=host, port=port, takeover=True,
+                         ready_file=ready_file).start()
+        with open(ready_file, "w") as f:
+            f.write(f"{r.host} {r.port}\n")
+        group.append(r)
+        return None
+
+    return spawn
+
+
+def test_router_group_survives_member_kill(tmp_path):
+    """Two routers share one SO_REUSEPORT port; SIGKILL-equivalent close
+    of one member mid-run costs the failover client ZERO errors — fresh
+    connections hash to the survivor, the resent tail lands there."""
+    sys.path.insert(0, str(REPO / "tools"))
+    from loadgen import run_loadgen_failover
+
+    with deadline(600):
+        model, servers, endpoints = _fleet(tmp_path, n=2)
+        group = []
+        try:
+            group = _router_group(endpoints, n=2)
+            addr = [(group[0].host, group[0].port)]
+            rep = {}
+            t = threading.Thread(target=lambda: rep.update(
+                run_loadgen_failover(addr, _synth_rows(64), qps=80,
+                                     duration_s=4.0)))
+            t.start()
+            time.sleep(1.0)   # connections established through the group
+            group[0].close()  # abrupt: no drain, no handoff
+            t.join()
+            assert rep["err"] == 0, rep
+            assert rep["ok"] > 0, rep
+            # the survivor answers the shared port
+            from difacto_tpu.serve.fleet import fresh_health
+            h = fresh_health(*addr[0])
+            assert h["router"] and h["status"] == "ready", h
+        finally:
+            _close_fleet(group, servers)
+
+
+def test_router_group_roll_zero_errors(tmp_path):
+    """run_router_group_roll replaces every member of a 2-router group
+    (census by server_id, handoff on a HELD connection to the incumbent,
+    wait-departed) while the failover client sees zero errors; the
+    successors refuse nothing and the incumbents are gone."""
+    sys.path.insert(0, str(REPO / "tools"))
+    from loadgen import run_loadgen_failover
+
+    from difacto_tpu.serve import run_router_group_roll
+
+    with deadline(600):
+        model, servers, endpoints = _fleet(tmp_path, n=2)
+        group = []
+        try:
+            group = _router_group(endpoints, n=2)
+            addr = [(group[0].host, group[0].port)]
+            rep = {}
+            t = threading.Thread(target=lambda: rep.update(
+                run_loadgen_failover(addr, _synth_rows(64), qps=80,
+                                     duration_s=6.0)))
+            t.start()
+            time.sleep(1.0)
+            roll = run_router_group_roll(
+                group[0].host, group[0].port, group_size=2,
+                spawn_fn=_inproc_router_spawn(endpoints, group),
+                wait_s=120.0)
+            t.join()
+            assert roll["ok"], roll
+            assert len(roll["routers"]) == 2, roll
+            incumbents = {r["incumbent"] for r in roll["routers"]}
+            successors = {r["successor"] for r in roll["routers"]}
+            assert not (incumbents & successors), roll
+            assert rep["err"] == 0, rep
+            assert rep["ok"] > 0, rep
+        finally:
+            _close_fleet(group, servers)
+
+
+def test_router_takeover_fault_refuses_roll(tmp_path):
+    """Armed ``router.takeover:err@1``: the ``#handoff`` control line is
+    refused as a typed ``!err`` BEFORE any drain state changes — the
+    incumbent keeps routing, and both fault surfaces saw the fire."""
+    from difacto_tpu.obs import REGISTRY
+    from difacto_tpu.serve import ServeClient
+
+    before = REGISTRY.value("faults_fired_total",
+                            point="router.takeover", kind="err")
+    with deadline(600):
+        model, servers, endpoints = _fleet(tmp_path, n=2)
+        router = None
+        try:
+            router = _router_or_skip(endpoints, takeover=True)
+            faultinject.configure("router.takeover:err@1")
+            with ServeClient(router.host, router.port) as c:
+                # the !err reply is not JSON: the typed refusal surfaces
+                with pytest.raises(ValueError):
+                    c.handoff(str(tmp_path / "nonexistent.ready"))
+                # the refusal left the router serving, not draining
+                h = c.health()
+                assert h["status"] == "ready", h
+                got = c.predict(_synth_rows(8))
+                assert all(g is not None for g in got), got
+        finally:
+            faultinject.configure("")
+            if router is not None:
+                router.close()
+            _close_fleet(servers)
+    assert REGISTRY.value("faults_fired_total", point="router.takeover",
+                          kind="err") > before
+
+
+def test_autoscale_spawn_fault_aborts_then_recovers(tmp_path):
+    """Armed ``autoscale.spawn:err@1``: the scale-up decision is refused
+    and counted in ``autoscale_aborts_total`` (the loop keeps running);
+    disarmed, the SAME overload signal produces a real spawn."""
+    from difacto_tpu.obs import REGISTRY
+    from difacto_tpu.serve import Autoscaler
+
+    before_f = REGISTRY.value("faults_fired_total",
+                              point="autoscale.spawn", kind="err")
+    before_a = REGISTRY.value("autoscale_aborts_total")
+    before_s = REGISTRY.value("autoscale_spawns_total")
+    spawned = []
+
+    def spawn_fn(idx):
+        spawned.append(idx)
+        return ("127.0.0.1", 59000 + idx)
+
+    box = {"p99": 1000.0}   # permanently past the SLO: always overloaded
+    with deadline(120):
+        scaler = Autoscaler(
+            [("127.0.0.1", 1)],   # unreachable fleet counts as overload
+            spawn_fn, min_replicas=1, max_replicas=3, poll_s=0.05,
+            up_ticks=1, cooldown_s=0.0, up_p99_ms=10.0,
+            latency_fn=lambda: box["p99"], timeout=0.2)
+        faultinject.configure("autoscale.spawn:err@1")
+        m = scaler.step()
+        assert m["action"] == "abort", m
+        assert spawned == [], "spawn_fn ran despite the injected refusal"
+        assert len(scaler.endpoints()) == 1
+        faultinject.configure("")
+        m = scaler.step()
+        assert m["action"] == "up", m
+        assert spawned == [1], spawned
+        assert len(scaler.endpoints()) == 2
+        assert [e["action"] for e in scaler.events] == ["abort", "up"]
+    assert REGISTRY.value("faults_fired_total", point="autoscale.spawn",
+                          kind="err") > before_f
+    assert REGISTRY.value("autoscale_aborts_total") > before_a
+    assert REGISTRY.value("autoscale_spawns_total") > before_s
+
+
+def test_fleet_chaos_compound_kill_roll_scale(tmp_path):
+    """Acceptance (ISSUE 18 headline, `make fleet-chaos`): 2 routers x
+    2 replicas under open-loop load; mid-run we SIGKILL one router
+    (abrupt close), roll the replica fleet, AND force a scale-up — zero
+    client-visible !err, the autoscaler's spawn lands in the surviving
+    router's ring and its counter is visible through that router's
+    ``#metrics``, and the settled fleet sheds nothing."""
+    sys.path.insert(0, str(REPO / "tools"))
+    from loadgen import run_loadgen_failover
+
+    from difacto_tpu.obs import REGISTRY
+    from difacto_tpu.serve import (Autoscaler, ServeClient,
+                                   run_rolling_restart)
+
+    before_s = REGISTRY.value("autoscale_spawns_total")
+    rows = _synth_rows(64)
+    with deadline(600):
+        model, servers, endpoints = _fleet(tmp_path, n=2)
+        group, extra = [], []
+        scaler = None
+        try:
+            group = _router_group(endpoints, n=2)
+            addr = [(group[0].host, group[0].port)]
+
+            def spawn_fn(idx):
+                from difacto_tpu.serve import open_serving_store
+                store, _, _ = open_serving_store(model)
+                srv = _serve_or_skip(store, batch_size=64,
+                                     max_delay_ms=2.0, takeover=True)
+                extra.append(srv)
+                return (srv.host, srv.port)
+
+            box = {"p99": 0.0}
+            scaler = Autoscaler(
+                endpoints, spawn_fn, router=addr[0],
+                min_replicas=2, max_replicas=3, poll_s=0.1,
+                up_ticks=1, down_ticks=10 ** 6, cooldown_s=0.5,
+                up_p99_ms=50.0, latency_fn=lambda: box["p99"],
+                ewma=1.0).start()
+            rep = {}
+            t = threading.Thread(target=lambda: rep.update(
+                run_loadgen_failover(addr, rows, qps=80,
+                                     duration_s=8.0)))
+            t.start()
+            time.sleep(1.0)      # traffic established through the group
+            group[0].close()     # CHAOS 1: kill a router group member
+            time.sleep(0.5)
+            roll = run_rolling_restart(   # CHAOS 2: roll every replica
+                endpoints, spawn_fn=_inproc_spawn(model, servers),
+                wait_s=60.0)
+            box["p99"] = 1000.0  # CHAOS 3: force a scale-up mid-run
+            t_spawn = time.monotonic()
+            while (not any(e["action"] == "up" for e in scaler.events)
+                   and time.monotonic() - t_spawn < 20.0):
+                time.sleep(0.05)
+            box["p99"] = 0.0
+            t.join()
+            assert roll["ok"], roll
+            assert rep["err"] == 0, rep
+            assert rep["ok"] > 0, rep
+            ups = [e for e in scaler.events if e["action"] == "up"]
+            assert len(ups) >= 1, scaler.events
+            assert REGISTRY.value("autoscale_spawns_total") > before_s
+            # the spawn is OBSERVABLE through the surviving router: the
+            # new replica joined its ring and the autoscaler's counter
+            # rides the router's #metrics (global-registry merge)
+            with ServeClient(*addr[0]) as c:
+                h = c.health()
+                assert h["router"] and h["status"] == "ready", h
+                assert h["replicas_live"] == 3, h
+                text = c.metrics()
+                assert "autoscale_spawns_total" in text, text[:400]
+                assert "router_affinity_hit_rate" in text, text[:400]
+            # settled: a fresh post-chaos window sheds nothing and errs
+            # nothing through the 3-replica ring
+            rep2 = run_loadgen_failover(addr, rows, qps=80,
+                                        duration_s=1.5)
+            assert rep2["err"] == 0, rep2
+            assert rep2["shed"] == 0, rep2
+        finally:
+            if scaler is not None:
+                scaler.close()
+            _close_fleet(group, servers, extra)
+
+
+def test_router_group_supervisor_relaunches_dead_member():
+    """tools/fleet.py run_router_group: a member that dies is relaunched
+    on launch.py's backoff schedule (counted), live members are left
+    alone, and teardown terminates the group."""
+    sys.path.insert(0, str(REPO / "tools"))
+    import fleet as fleet_cli
+
+    from difacto_tpu.obs import REGISTRY
+
+    before = REGISTRY.value("router_group_relaunches_total")
+    sleeps = []
+
+    def sleep_fn(d):
+        sleeps.append(d)
+        time.sleep(min(d, 0.02))
+
+    def cmd_fn(i):
+        # member 0 lives; member 1 exits immediately (the crash loop)
+        if i == 0:
+            return [sys.executable, "-c",
+                    "import time; time.sleep(60)"]
+        return [sys.executable, "-c", "pass"]
+
+    with deadline(120):
+        rep = fleet_cli.run_router_group(
+            2, cmd_fn, max_seconds=3.0, poll_s=0.01,
+            backoff_base_s=0.01, sleep_fn=sleep_fn,
+            max_relaunches=3)
+    assert rep["ok"], rep
+    assert rep["relaunches"] == 3, rep
+    assert len(sleeps) >= 3, sleeps
+    assert REGISTRY.value("router_group_relaunches_total") >= before + 3

@@ -370,3 +370,52 @@ def test_no_serve_threads_leak_overall():
     names = [t.name for t in threading.enumerate()
              if t.name.startswith("serve-")]
     assert not names, names
+
+
+def test_router_affinity_matches_pred_bit_for_bit(trained_model,
+                                                  rcv1_path):
+    """Affinity routing is cache placement, never correctness (ISSUE
+    18): the same 100 rows routed ``balance=affinity`` across TWO
+    replicas come back byte-identical to the task=pred golden — the
+    per-owner partition + positional splice preserves request order and
+    every replica serves the full model — and the affinity hit/miss
+    counters and hit-rate gauge are live on the router."""
+    from difacto_tpu.serve import (RouterServer, ServeClient,
+                                   ServeServer, open_serving_store)
+    rows = fixture_rows(rcv1_path)
+    with deadline(120):
+        store_a, _, _ = open_serving_store(trained_model["model"])
+        store_b, _, _ = open_serving_store(trained_model["model"])
+        try:
+            srv_a = ServeServer(store_a, batch_size=100,
+                                max_delay_ms=50.0).start()
+        except OSError as e:  # pragma: no cover - loaded CI box
+            pytest.skip(f"cannot bind a serving port: {e}")
+        srv_b = ServeServer(store_b, batch_size=100,
+                            max_delay_ms=50.0).start()
+        router = None
+        try:
+            try:
+                router = RouterServer(
+                    [(srv_a.host, srv_a.port), (srv_b.host, srv_b.port)],
+                    balance="affinity").start()
+            except OSError as e:  # pragma: no cover
+                pytest.skip(f"cannot bind the router port: {e}")
+            with ServeClient(router.host, router.port) as c:
+                resp = c.score_lines(rows)
+                st = c.stats()
+                text = c.metrics()
+        finally:
+            if router is not None:
+                router.close()
+            srv_a.close()
+            srv_b.close()
+    pred_probs = [l.split(b"\t")[1] for l in trained_model["pred_lines"]]
+    assert resp == pred_probs
+    # with every owner live and untried, every forward is an affinity
+    # hit; both replicas carried rows (the ring actually partitions)
+    assert st["balance"] == "affinity", st
+    assert st["affinity_hits"] > 0, st
+    assert st["affinity_misses"] == 0, st
+    assert all(b["rows"] > 0 for b in st["backends"]), st
+    assert "router_affinity_hit_rate 1" in text, text[:400]

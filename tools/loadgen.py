@@ -31,6 +31,15 @@ rolling-restart run shows which replica absorbed each handoff window.
 (serve/fleethealth.py): ejections propagate to/from every other client
 and the router.
 
+``--profile diurnal`` shapes the offered rate over the run as a
+piecewise-linear multiplier of ``--qps`` (trough → morning ramp → peak
+at 1.6x → evening decay → trough), the day-cycle in miniature that an
+elastic fleet must follow: bench.py --serve and the autoscaler chaos
+runs use it to force a scale-up mid-run and a drain after the peak.
+``flat`` (the default) keeps the constant-rate schedule. The schedule
+stays open-loop either way — the multiplier rides on the SCHEDULED
+arrival time, not on response progress.
+
 ``--label-rate R --label-delay-s D`` switches to the FEEDBACK driver
 (``run_loadgen_feedback``) for the online-learning loop
 (docs/serving.md "Continuous learning"): every arrival is sent as
@@ -61,11 +70,38 @@ def _to_bytes(line: Line) -> bytes:
     return b if b.endswith(b"\n") else b + b"\n"
 
 
+# QPS profiles: (run_fraction, multiplier) anchors, piecewise-linear in
+# between. ``diurnal`` is a day cycle compressed into one run — trough,
+# ramp, 1.6x peak, decay — sized so a fleet provisioned for the mean
+# must scale up through the peak and back down after it.
+PROFILES = {
+    "flat": ((0.0, 1.0), (1.0, 1.0)),
+    "diurnal": ((0.0, 0.3), (0.25, 1.0), (0.5, 1.6),
+                (0.75, 0.8), (1.0, 0.3)),
+}
+
+
+def profile_qps(profile, qps: float, frac: float) -> float:
+    """The instantaneous target rate at fraction ``frac`` (0..1) of the
+    run: ``qps`` times the profile's piecewise-linear multiplier.
+    ``profile`` is a name from :data:`PROFILES` or an anchor sequence."""
+    anchors = PROFILES[profile] if isinstance(profile, str) else \
+        tuple(profile)
+    f = min(max(frac, 0.0), 1.0)
+    for (f0, m0), (f1, m1) in zip(anchors, anchors[1:]):
+        if f <= f1:
+            w = 0.0 if f1 <= f0 else (f - f0) / (f1 - f0)
+            return qps * (m0 + (m1 - m0) * w)
+    return qps * anchors[-1][1]
+
+
 def run_loadgen(host: str, port: int, rows: Sequence[Line], qps: float,
                 duration_s: float, seed: int = 0,
-                recv_timeout: float = 30.0) -> dict:
+                recv_timeout: float = 30.0,
+                profile: str = "flat") -> dict:
     """Drive the server open-loop at ``qps`` for ``duration_s`` seconds,
-    cycling through ``rows``. Returns the latency/throughput report."""
+    cycling through ``rows``; ``profile`` shapes the rate over the run
+    (:func:`profile_qps`). Returns the latency/throughput report."""
     rows = [_to_bytes(r) for r in rows]
     if not rows:
         raise ValueError("loadgen needs at least one request row")
@@ -83,7 +119,7 @@ def run_loadgen(host: str, port: int, rows: Sequence[Line], qps: float,
 
     def sender() -> None:
         nonlocal sent
-        t_next = time.monotonic()
+        t0 = t_next = time.monotonic()
         t_end = t_next + duration_s
         i = 0
         while True:
@@ -106,11 +142,13 @@ def run_loadgen(host: str, port: int, rows: Sequence[Line], qps: float,
                 break
             sent += 1
             i += 1
-            # exponential gaps: Poisson arrivals at the target rate.
+            # exponential gaps: Poisson arrivals at the target rate
+            # (profile-shaped at the SCHEDULED time, not the send time).
             # Falling behind (a slow send) is NOT forgiven — the next
             # arrival time advances by the schedule, keeping the offered
             # rate honest even when the socket pushes back.
-            t_next += rng.exponential(1.0 / qps)
+            t_next += rng.exponential(1.0 / profile_qps(
+                profile, qps, (t_next - t0) / duration_s))
         # half-close: the server reader sees EOF, drains queued futures,
         # and the responses for every sent row still arrive below
         try:
@@ -338,7 +376,8 @@ def run_loadgen_feedback(host: str, port: int, rows: Sequence[Line],
 def run_loadgen_failover(endpoints, rows: Sequence[Line], qps: float,
                          duration_s: float, seed: int = 0,
                          retries: int = 8, chunk: int = 64,
-                         timeout: float = 30.0, blacklist=None) -> dict:
+                         timeout: float = 30.0, blacklist=None,
+                         profile: str = "flat") -> dict:
     """Open-loop schedule over the failover ``ServeClient``: due rows
     are pipelined in chunks of at most ``chunk``; a dropped replica is
     absorbed by the client (reconnect / next endpoint / resend tail),
@@ -349,7 +388,8 @@ def run_loadgen_failover(endpoints, rows: Sequence[Line], qps: float,
     health (serve/fleethealth.py). The report's ``endpoints`` list is
     the per-endpoint summary — rows answered, failovers absorbed,
     ejections — so a rollout chaos run shows WHICH replica carried the
-    handoff window, not just fleet totals."""
+    handoff window, not just fleet totals. ``profile`` shapes the rate
+    over the run (:func:`profile_qps`)."""
     from difacto_tpu.serve import ServeClient
     rows = [_to_bytes(r) for r in rows]
     if not rows:
@@ -370,7 +410,8 @@ def run_loadgen_failover(endpoints, rows: Sequence[Line], qps: float,
             while t_next <= now and t_next < t_end and len(due) < chunk:
                 due.append((rows[i % len(rows)], t_next))
                 i += 1
-                t_next += rng.exponential(1.0 / qps)
+                t_next += rng.exponential(1.0 / profile_qps(
+                    profile, qps, (t_next - t_start) / duration_s))
             if not due:
                 time.sleep(min(max(t_next - now, 0.0), 0.01))
                 continue
@@ -430,6 +471,10 @@ def main() -> None:
     ap.add_argument("--max-rows", type=int, default=100000,
                     help="cap on distinct rows read from --data")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--profile", default="flat",
+                    choices=sorted(PROFILES),
+                    help="shape of the offered rate over the run: "
+                         "flat, or the diurnal trough/peak cycle")
     ap.add_argument("--label-rate", type=float, default=0.0,
                     help="feedback mode: report each row's own label "
                          "back for this fraction of #score'd rows")
@@ -455,7 +500,7 @@ def main() -> None:
         rep = run_loadgen_failover(
             args.endpoints, rows, args.qps, args.duration,
             seed=args.seed, retries=args.retries,
-            blacklist=args.blacklist or None)
+            blacklist=args.blacklist or None, profile=args.profile)
         print(json.dumps(rep))
         # the per-endpoint summary, one human line each: which replica
         # answered the rows, who failed over, who got ejected
@@ -472,7 +517,8 @@ def main() -> None:
             seed=args.seed)))
     else:
         print(json.dumps(run_loadgen(args.host, args.port, rows, args.qps,
-                                     args.duration, seed=args.seed)))
+                                     args.duration, seed=args.seed,
+                                     profile=args.profile)))
 
 
 if __name__ == "__main__":

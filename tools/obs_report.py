@@ -123,6 +123,41 @@ def report_gauges(snap: dict) -> None:
     print()
 
 
+def report_fleet(snap: dict) -> None:
+    """Fleet-elasticity digest (docs/observability.md): the autoscaler's
+    decisions (``autoscale_*``) and the router group's supervision
+    (``router_group_*``) in one block, so a chaos/diurnal run's capacity
+    story reads without hunting through the counter table."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+
+    def _total(section, name):
+        series = section.get(name)
+        if not series:
+            return None
+        return sum(series.values())
+
+    rows = []
+    for name in ("autoscale_spawns_total", "autoscale_drains_total",
+                 "autoscale_aborts_total",
+                 "router_group_relaunches_total"):
+        v = _total(counters, name)
+        if v is not None:
+            rows.append((name, v))
+    for name in ("autoscale_replicas", "router_group_size",
+                 "autoscale_queue_frac", "autoscale_shed_rate",
+                 "router_affinity_hit_rate"):
+        v = _total(gauges, name)
+        if v is not None:
+            rows.append((name, v))
+    if not rows:
+        return
+    print("== fleet elasticity (autoscaler + router group) ==")
+    for label, v in rows:
+        print(f"  {label:54s} {v:g}")
+    print()
+
+
 def report_counters(snap: dict, top: int = 20) -> None:
     rows = []
     for name, series in snap.get("counters", {}).items():
@@ -174,6 +209,7 @@ def main() -> int:
         snap = load_last_snapshot(args.metrics)
         report_stages(snap)
         report_hists(snap)
+        report_fleet(snap)
         report_gauges(snap)
         report_counters(snap, args.top)
     if args.trace:
