@@ -17,7 +17,8 @@ DATA_OUT ?= $(basename $(DATA_IN)).rec
 
 .PHONY: test smoke ci lint lint-changed lint-baseline lockmap jitmap \
 	hlomap chaos fleet-chaos online-chaos obs-report convert \
-	stream-bench multichip-bench kernel-parity online-bench
+	stream-bench multichip-bench kernel-parity online-bench \
+	capacity-bench
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -142,3 +143,9 @@ multichip-bench:
 # rows_per_s, train_behind_serve_s_p99, reload_count, label_join_rate)
 online-bench:
 	$(PY) bench.py --online
+
+# table-capacity levers (ISSUE 19; docs/perf_notes.md "Table capacity"):
+# quantized-slot AUC legs at 2x/4x/8x effective capacity vs the fp32
+# baseline + cold-tier hit-rate across zipf skews
+capacity-bench:
+	$(PY) bench.py --capacity

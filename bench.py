@@ -528,7 +528,8 @@ def run_serve_bench(args) -> dict:
         before = server.executor.stats()["buckets_compiled"]
         rep = run_loadgen(server.host, server.port, rows,
                           qps=args.serve_qps,
-                          duration_s=args.serve_seconds)
+                          duration_s=args.serve_seconds,
+                          zipf_alpha=args.zipf_alpha)
         after = server.executor.stats()["buckets_compiled"]
         snap = server.stats_snapshot()
         # resilience cost (ISSUE 3): hot-reload latency over the wire —
@@ -854,6 +855,139 @@ def run_multichip(args) -> dict:
     return rep
 
 
+def _gen_capacity_libsvm(path: str, nrows: int, nfeat: int, alpha: float,
+                         seed: int, w: np.ndarray) -> None:
+    """Synthetic planted-model libsvm rows: zipf(alpha)-ranked feature
+    ids, labels drawn from the logistic of the planted weights — so a
+    config's validation AUC measures how much signal its table kept."""
+    rng = np.random.RandomState(seed)
+    nnz = 8
+    ranks = (rng.zipf(alpha, (nrows, nnz)) - 1) % nfeat
+    with open(path, "w") as f:
+        for r in ranks:
+            ids = np.unique(r)
+            p = 1.0 / (1.0 + np.exp(-w[ids].sum()))
+            y = 1 if rng.random_sample() < p else 0
+            f.write(f"{y} " + " ".join(f"{i}:1" for i in ids) + "\n")
+
+
+def run_capacity_bench(args) -> dict:
+    """``--capacity`` (bare) mode — the quality-vs-capacity story of the
+    three table-capacity levers (ISSUE 19; docs/perf_notes.md "Table
+    capacity"):
+
+      quality : train the same planted-model data at equal-ish per-device
+                byte budgets: fp32 at the base capacity vs int8/fp8 legs
+                at 2x/4x/8x the rows (the 8x leg stacks the cold tier on
+                int8), each leg reporting validation AUC, its delta vs
+                the fp32 baseline, and the store's own capacity_stats
+                accounting (bytes/device, effective rows, multiplier);
+      tier    : cold-tier hit rate across >= 2 zipf skews — the number
+                that says whether a half-resident table serves the hot
+                set from device rows.
+    """
+    import tempfile
+
+    from difacto_tpu.learners import Learner
+    from difacto_tpu.store.local import SlotStore
+    from difacto_tpu.updaters.sgd_updater import SGDUpdaterParam
+
+    base_cap = args.capacity_base
+    vdim = 4
+    nfeat = base_cap * 16
+    rng = np.random.RandomState(7)
+    w_true = rng.randn(nfeat) * 0.7
+
+    def cap_stats(slot_dtype: str, cap: int, cold: int) -> dict:
+        p, _ = SGDUpdaterParam.init_allow_unknown([
+            ("V_dim", str(vdim)), ("hash_capacity", str(cap)),
+            ("slot_dtype", slot_dtype), ("cold_tier_rows", str(cold))])
+        return SlotStore(p).capacity_stats()
+
+    with tempfile.TemporaryDirectory() as d:
+        train_p, val_p = f"{d}/train.libsvm", f"{d}/val.libsvm"
+        _gen_capacity_libsvm(train_p, 3000, nfeat, 1.3, 1, w_true)
+        _gen_capacity_libsvm(val_p, 1500, nfeat, 1.3, 2, w_true)
+
+        def train_auc(slot_dtype: str, cap: int, cold: int = 0) -> float:
+            aucs = []
+            learner = Learner.create("sgd")
+            learner.init([
+                ("data_in", train_p), ("data_val", val_p),
+                ("data_format", "libsvm"), ("loss", "fm"),
+                ("V_dim", str(vdim)), ("V_threshold", "0"),
+                ("lr", "0.1"), ("l1", "1e-5"),
+                ("batch_size", "256"), ("shuffle", "0"),
+                ("max_num_epochs", "3"), ("num_jobs_per_epoch", "1"),
+                ("report_interval", "0"), ("stop_rel_objv", "0"),
+                ("stop_val_auc", "0"), ("device_cache_mb", "0"),
+                ("hash_capacity", str(cap)),
+                ("slot_dtype", slot_dtype),
+                ("cold_tier_rows", str(cold))])
+            learner.add_epoch_end_callback(
+                lambda e, t, v: aucs.append(v.auc / max(v.nrows, 1.0)))
+            learner.run()
+            return aucs[-1]
+
+        base_auc = train_auc("fp32", base_cap)
+        base_stats = cap_stats("fp32", base_cap, 0)
+        base_bytes = max(base_stats["table_bytes_per_device"], 1)
+        legs = []
+        for slot_dtype, mult, cold_frac in (("int8", 2, 0.0),
+                                            ("int8", 4, 0.0),
+                                            ("fp8", 4, 0.0),
+                                            ("int8", 8, 0.5)):
+            cap = base_cap * mult
+            cold = int(cap * cold_frac)
+            auc = train_auc(slot_dtype, cap, cold)
+            stats = cap_stats(slot_dtype, cap, cold)
+            legs.append({
+                "slot_dtype": slot_dtype,
+                "capacity_mult": mult,
+                "cold_tier_rows": cold,
+                "auc": round(auc, 5),
+                "auc_delta_vs_fp32": round(auc - base_auc, 5),
+                "bytes_ratio_vs_fp32": round(
+                    stats["table_bytes_per_device"] / base_bytes, 3),
+                "capacity_stats": stats,
+            })
+
+    # tier hit-rate across skews: stream zipf keys through a
+    # half-resident table and read the tier's own counters
+    def tier_hit_rate(alpha: float, cap: int = 4096,
+                      steps: int = 50, batch: int = 512) -> dict:
+        p, _ = SGDUpdaterParam.init_allow_unknown([
+            ("V_dim", "4"), ("hash_capacity", str(cap)),
+            ("cold_tier_rows", str(cap // 2))])
+        store = SlotStore(p)
+        krng = np.random.RandomState(int(alpha * 100))
+        h0 = store.tier._hits.value()
+        m0 = store.tier._misses.value()
+        for _ in range(steps):
+            keys = np.unique(
+                ((krng.zipf(alpha, batch) - 1) % (cap * 4)).astype(np.int64))
+            store.pull(keys)
+        h = store.tier._hits.value() - h0
+        m = store.tier._misses.value() - m0
+        return {"zipf_alpha": alpha,
+                "hit_rate": round(h / max(h + m, 1), 4),
+                "hits": int(h), "misses": int(m)}
+
+    tier_legs = [tier_hit_rate(a) for a in args.capacity_alphas]
+    x8 = legs[-1]["capacity_stats"]
+    return {
+        "baseline": {"slot_dtype": "fp32", "auc": round(base_auc, 5),
+                     "capacity_stats": base_stats},
+        "quality_vs_capacity": legs,
+        "tier_hit_rate": tier_legs,
+        # the acceptance number: logical rows per device of the stacked
+        # int8+tier leg over the fp32/no-tier rows the same per-device
+        # bytes would hold
+        "effective_rows_per_device": x8["effective_rows_per_device"],
+        "capacity_multiplier_x8_leg": x8["capacity_multiplier"],
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=65536)
@@ -861,7 +995,23 @@ def main() -> None:
     ap.add_argument("--nnz-per-row", type=int, default=39)  # criteo density
     ap.add_argument("--uniq", type=int, default=1 << 17,
                     help="feature-id space each batch draws from")
-    ap.add_argument("--capacity", type=int, default=1 << 21)
+    ap.add_argument("--capacity", nargs="?", const="bench",
+                    default=1 << 21,
+                    help="table rows when given a value; passed BARE it "
+                         "selects the table-capacity bench instead "
+                         "(quantized-slot AUC legs at 2x/4x/8x effective "
+                         "capacity + cold-tier hit-rate across zipf "
+                         "skews; docs/perf_notes.md \"Table capacity\")")
+    ap.add_argument("--capacity-base", type=int, default=1024,
+                    help="fp32 baseline hash_capacity of the --capacity "
+                         "bench quality legs")
+    ap.add_argument("--capacity-alphas", default="1.1,1.6",
+                    help="comma-separated zipf skews for the --capacity "
+                         "bench tier hit-rate legs")
+    ap.add_argument("--zipf-alpha", type=float, default=0.0,
+                    help="serve-bench request skew: forwarded to the "
+                         "loadgen row picker (tools/loadgen.py "
+                         "make_picker); 0 keeps the round-robin cycle")
     ap.add_argument("--dist", choices=("zipf", "uniform"), default="zipf",
                     help="feature frequency skew (criteo is heavy-tailed)")
     ap.add_argument("--vdtype", choices=("float32", "bfloat16"),
@@ -939,6 +1089,12 @@ def main() -> None:
                          "lowering keeps the flat-path rate; 2x4 on the "
                          "virtual CPU mesh checks multi-device)")
     args = ap.parse_args()
+    # bare --capacity is the capacity-bench mode; with a value it stays
+    # the table-rows knob every other mode reads
+    capacity_mode = args.capacity == "bench"
+    args.capacity = (1 << 21) if capacity_mode else int(args.capacity)
+    args.capacity_alphas = tuple(
+        float(a) for a in str(args.capacity_alphas).split(",") if a)
 
     # honor an explicit JAX_PLATFORMS=cpu (the documented virtual-mesh
     # usage, e.g. --mesh 2x4 with 8 forced host devices) before the
@@ -946,6 +1102,9 @@ def main() -> None:
     from difacto_tpu.utils.platform import apply_env_platform
     apply_env_platform()
 
+    if capacity_mode:
+        print(json.dumps({"capacity": run_capacity_bench(args)}))
+        return
     if args.e2e:
         print(json.dumps(run_e2e(args)))
         return

@@ -1,0 +1,392 @@
+"""Host-RAM cold tier behind the device slot table (ISSUE 19).
+
+With ``cold_tier_rows = R > 0`` the hashed store's LOGICAL slot space
+keeps its full ``hash_capacity = L`` rows, but the device table holds
+only ``D = L - R`` HOT rows; the tail lives in host RAM (this module)
+and rows move between the two in batches on the dispatch thread:
+
+- every batch's sorted-unique logical slots are ROUTED to device rows
+  before staging (:meth:`ColdTier.route` / :func:`route_payload`): a
+  resident slot is a tier HIT; a miss PROMOTES the slot's row from host
+  RAM (or builds its virgin init row) into a free device row, demoting
+  the least-recently-touched resident rows to host RAM when the hot set
+  is full. The routed row vector is re-sorted and the payload's index
+  cells are rewritten through the position permutation, so the table
+  kernels' sorted+unique declarations stay truthful.
+- promotes/demotes are batched gathers/scatters over the SAME fused-row
+  ops the step uses (ops/fused.gather_rows/scatter_rows, OOB-padded to
+  bucketed shapes so they reuse a handful of compiled programs), riding
+  the dispatch thread between steps — no background thread, no lock.
+- fault points ``store.demote`` / ``store.promote`` (utils/faultinject):
+  a failed demote keeps its victims HOT (still serving; this batch's
+  misses degrade to the OOB lane and read zeros), a failed promote
+  degrades only the missing slots. Both leave the table consistent.
+
+Counters (docs/observability.md): ``store_tier_hits_total``,
+``store_tier_misses_total``, ``store_tier_promotes_total``,
+``store_tier_demotes_total``.
+
+The tier is exact, not approximate: a demoted row's container bytes
+round-trip bit-identically (quantization scales included), and virgin
+cold rows get deterministic per-slot init values — but note the DEVICE
+table is smaller than the untiered one, so the init value stream (keyed
+by table shape) differs from an untiered run at the same
+hash_capacity. Requires the hashed store and V_dim > 0 (the fused-row
+layout); the learner forces device_dedup / stream_chunks / batch-cache
+replay off while routing is active (learners/sgd.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.faultinject import FaultInjected, fire
+from ..updaters.sgd_updater import (TRASH_SLOT, build_rows, row_layout,
+                                    v_dtype)
+
+
+def _bucket(n: int) -> int:
+    from ..ops.batch import bucket
+    return bucket(n)
+
+
+class ColdTier:
+    """Residency maps + host row storage for one SlotStore.
+
+    Single-threaded by design: every method runs on the store's dispatch
+    (or serve-executor) thread, interleaved with step dispatch — the
+    same thread that owns ``store.state``.
+    """
+
+    def __init__(self, store) -> None:
+        param = store.param
+        self.store = store
+        self.param = param
+        self.L = param.hash_capacity
+        self.D = self.L - param.cold_tier_rows
+        self.layout = row_layout(param, self.D)
+        self._np_dtype = np.dtype(v_dtype(param))
+        # residency: logical slot -> device row (-1 = cold); device row
+        # -> owning slot (-1 = free). Identity prefix at init: slots
+        # [0, D) hot at row == slot, tail [D, L) cold.
+        self._resident = np.full(self.L, -1, dtype=np.int64)
+        self._resident[:self.D] = np.arange(self.D)
+        self._owner = np.arange(self.D, dtype=np.int64)
+        # logical LRU clock (no wall time — lint wall-clock rule)
+        self._clock = np.zeros(self.D, dtype=np.int64)
+        self._tick = 0
+        # demoted rows: logical slot -> fused device-layout row bytes
+        self._rows: dict = {}
+        # deterministic virgin V init for the cold tail [D, L): a
+        # distinct PRNG stream from the device table's init (the table
+        # shapes differ, so matching the untiered stream is impossible
+        # anyway; determinism across hosts is what matters)
+        k = param.V_dim
+        key = jax.random.fold_in(jax.random.PRNGKey(param.seed), 1)
+        self._virgin_V = np.asarray(
+            (jax.random.uniform(key, (self.L - self.D, k),
+                                dtype=jnp.float32) - 0.5)
+            * param.V_init_scale)
+        from ..obs import REGISTRY
+        self._hits = REGISTRY.counter(
+            "store_tier_hits_total",
+            "batch slots already resident in the device hot tier")
+        self._misses = REGISTRY.counter(
+            "store_tier_misses_total",
+            "batch slots that were cold (host tier) when requested")
+        self._promotes = REGISTRY.counter(
+            "store_tier_promotes_total",
+            "rows promoted host tier -> device hot rows")
+        self._demotes = REGISTRY.counter(
+            "store_tier_demotes_total",
+            "rows demoted device hot rows -> host tier")
+
+    # ------------------------------------------------------------ stats
+    def stats(self) -> dict:
+        return {
+            "logical_rows": self.L,
+            "device_rows": self.D,
+            "resident": int((self._owner >= 0).sum()),
+            "cold_stored": len(self._rows),
+        }
+
+    # ------------------------------------------------------------ route
+    def route(self, slots: np.ndarray
+              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Sorted-unique logical slots (producer pads >= L welcome) ->
+        ``(routed, order, perm)``: ``routed`` is the same-length device
+        row vector, re-sorted ascending with canonical OOB padding
+        (``D + position``) for pads and degraded slots; ``order[j]`` is
+        the input position now living at routed position ``j``;
+        ``perm[p]`` is the routed position of input position ``p`` (the
+        index-cell rewrite map)."""
+        s = np.asarray(slots, dtype=np.int64)
+        n = len(s)
+        self._tick += 1
+        out = np.empty(n, dtype=np.int64)
+        real_idx = np.nonzero(s < self.L)[0]
+        # pads (and any degraded slot below) get a big distinct value so
+        # the sort keeps them unique; canonicalized to D + j after
+        out[s >= self.L] = 2 * self.L + np.nonzero(s >= self.L)[0]
+        rows = self._resident[s[real_idx]]
+        hit = rows >= 0
+        out[real_idx[hit]] = rows[hit]
+        self._hits.inc(int(hit.sum()))
+        miss_idx = real_idx[~hit]
+        if len(miss_idx):
+            self._misses.inc(len(miss_idx))
+            granted = self._promote(s[miss_idx], protect=rows[hit])
+            ok = granted >= 0
+            out[miss_idx[ok]] = granted[ok]
+            out[miss_idx[~ok]] = 2 * self.L + miss_idx[~ok]
+        dev = out[out < self.D]
+        self._clock[dev] = self._tick
+        order = np.argsort(out, kind="stable")
+        routed = out[order]
+        n_pad = int((routed >= self.D).sum())
+        if n_pad:
+            routed[n - n_pad:] = self.D + np.arange(n - n_pad, n)
+        perm = np.empty(n, dtype=np.int64)
+        perm[order] = np.arange(n)
+        return routed.astype(np.int32), order, perm
+
+    # -------------------------------------------------- promote / demote
+    def _tier_values(self, slots: np.ndarray) -> np.ndarray:
+        """Host fused-row values for ``slots`` (device layout): demoted
+        bytes verbatim, virgin init rows for never-trained tail slots."""
+        _, _, Wx, _ = self.layout
+        vals = np.zeros((len(slots), Wx), dtype=self._np_dtype)
+        virgin_i, virgin_s = [], []
+        for i, sl in enumerate(np.asarray(slots, np.int64)):
+            row = self._rows.pop(int(sl), None)
+            if row is not None:
+                vals[i] = row
+            else:
+                virgin_i.append(i)
+                virgin_s.append(int(sl) - self.D)
+        if virgin_i:
+            V = self._virgin_V[np.asarray(virgin_s)]
+            z = jnp.zeros(len(virgin_i), jnp.float32)
+            built = build_rows(self.param, self.D, V, np.zeros_like(V),
+                               z, z, z, z,
+                               jnp.zeros(len(virgin_i), dtype=bool))
+            vals[np.asarray(virgin_i)] = np.asarray(built)
+        return vals
+
+    def _promote(self, miss_slots: np.ndarray,
+                 protect: np.ndarray) -> np.ndarray:
+        """Bring ``miss_slots`` (sorted unique, all cold) on-device.
+        Returns the granted device row per slot, -1 where the slot
+        stays cold this batch (promote/demote fault, or no evictable
+        row left). Fires ``store.promote``; demotes LRU victims via
+        :meth:`_demote` (``store.demote``) when the hot set is full."""
+        need = len(miss_slots)
+        grant = np.full(need, -1, dtype=np.int64)
+        free = np.nonzero(self._owner < 0)[0]
+        if len(free) < need:
+            self._demote_lru(need - len(free), protect)
+            free = np.nonzero(self._owner < 0)[0]
+        m = min(len(free), need)
+        if m == 0:
+            return grant
+        try:
+            fire("store.promote")
+        except FaultInjected:
+            # the missing slots stay cold and this batch reads zeros
+            # for them (OOB lanes); nothing was moved, nothing torn
+            return grant
+        dest = free[:m]
+        vals = self._tier_values(miss_slots[:m])
+        cap = _bucket(m)
+        from ..store.local import pad_slots_oob
+        from ..ops import fused
+        pad = pad_slots_oob(dest.astype(np.int32), cap, self.D)
+        _, _, Wx, _ = self.layout
+        vp = np.zeros((cap, Wx), dtype=self._np_dtype)
+        vp[:m] = vals
+        st = self.store.state
+        VVg = fused.scatter_rows(st.VVg, jnp.asarray(pad), jnp.asarray(vp))
+        self.store.state = self.store._place(st._replace(VVg=VVg))
+        self._resident[miss_slots[:m]] = dest
+        self._owner[dest] = miss_slots[:m]
+        self._clock[dest] = self._tick
+        self._promotes.inc(m)
+        grant[:m] = dest
+        return grant
+
+    def _demote_lru(self, count: int, protect: np.ndarray) -> int:
+        """Demote up to ``count`` least-recently-touched resident rows,
+        never touching ``protect`` (this batch's hit rows) or the trash
+        row."""
+        cand = self._owner >= 0
+        cand[TRASH_SLOT] = False
+        cand[np.asarray(protect, dtype=np.int64)] = False
+        rows = np.nonzero(cand)[0]
+        if not len(rows):
+            return 0
+        count = min(count, len(rows))
+        if count < len(rows):
+            part = np.argpartition(self._clock[rows], count - 1)[:count]
+            victims = rows[part]
+        else:
+            victims = rows
+        victims = np.sort(victims)
+        return self._demote(victims)
+
+    def _demote(self, victims: np.ndarray) -> int:
+        """Demote the given device rows (sorted unique) to host RAM.
+        On an injected ``store.demote`` fault the victims stay hot and
+        keep serving — the move is fetch-then-forget, so a failure
+        before the fetch leaves the device row untouched."""
+        n = len(victims)
+        if n == 0:
+            return 0
+        try:
+            fire("store.demote")
+        except FaultInjected:
+            return 0
+        from ..store.local import pad_slots_oob
+        from ..ops import fused
+        cap = _bucket(n)
+        pad = pad_slots_oob(victims.astype(np.int32), cap, self.D)
+        rows_j = fused.gather_rows(self.store.state.VVg, jnp.asarray(pad))
+        vals = np.asarray(rows_j)[:n]
+        owners = self._owner[victims]
+        for sl, val in zip(owners, vals):
+            self._rows[int(sl)] = val
+        self._resident[owners] = -1
+        self._owner[victims] = -1
+        self._demotes.inc(n)
+        return n
+
+    def demote_rows(self, victims: np.ndarray) -> int:
+        """Occupancy-pressure eviction entry (SlotStore.maybe_evict):
+        demote specific device rows to the host tier. The rows remain
+        fully addressable — eviction under a tier loses nothing."""
+        victims = np.asarray(victims, dtype=np.int64)
+        victims = victims[(victims != TRASH_SLOT)
+                          & (self._owner[victims] >= 0)]
+        return self._demote(np.sort(victims))
+
+    # ------------------------------------------------------- checkpoint
+    def logical_cols(self, device_cols: dict) -> dict:
+        """Device-table columns [D rows] -> LOGICAL columns [L rows] for
+        checkpointing: hot rows land at their owning slot, demoted rows
+        decode from their stored fused bytes, virgin tail slots carry
+        their init V (zero scalars) — the same dense view an untiered
+        store of capacity L would save."""
+        from ..updaters.sgd_updater import scal_f32, quantized
+        from ..ops import fused
+        k, h, _, off = self.layout
+        out = {}
+        for name, a in device_cols.items():
+            shape = (self.L,) + a.shape[1:]
+            out[name] = np.zeros(shape, dtype=a.dtype)
+        own = self._owner >= 0
+        rows = np.nonzero(own)[0]
+        for name, a in device_cols.items():
+            out[name][self._owner[rows]] = a[rows]
+        # virgin tail V init (scalars stay zero): tail slots neither
+        # resident on device nor demoted to a host row
+        if "V" in out:
+            virgin = np.ones(self.L - self.D, dtype=bool)
+            hot = self._owner[rows]
+            virgin[hot[hot >= self.D] - self.D] = False
+            for sl in self._rows:
+                if sl >= self.D:
+                    virgin[sl - self.D] = False
+            vs = np.nonzero(virgin)[0]
+            out["V"][self.D + vs] = self._virgin_V[vs]
+        if self._rows:
+            slots = np.fromiter(self._rows.keys(), dtype=np.int64,
+                                count=len(self._rows))
+            slots.sort()
+            rows_np = np.stack([self._rows[int(s)] for s in slots])
+            rj = jnp.asarray(rows_np)
+            f = np.asarray(scal_f32(rj[:, off:]))
+            cols = {"w": f[:, 0], "z": f[:, 1], "sqrt_g": f[:, 2],
+                    "cnt": f[:, 3], "v_live": f[:, 4] > 0}
+            if quantized(self.param):
+                cols["V"] = np.asarray(fused.dequant_half(
+                    rj[:, :k], jnp.asarray(f[:, 5]), self.param.slot_dtype))
+                cols["Vg"] = np.asarray(fused.dequant_half(
+                    rj[:, h:h + k], jnp.asarray(f[:, 6]),
+                    self.param.slot_dtype))
+            else:
+                cols["V"] = np.asarray(rj[:, :k], dtype=np.float32)
+                cols["Vg"] = np.asarray(rj[:, h:h + k], dtype=np.float32)
+            for name in out:
+                out[name][slots] = cols[name][: len(slots)]
+        return out
+
+    def load_cold(self, arr: dict) -> None:
+        """Seed the tier from a LOGICAL checkpoint column dict [L rows]:
+        residency resets to the identity prefix (slots [0, D) hot) and
+        the tail [D, L) is re-packed into host fused rows. Rows whose
+        columns are all-zero stay virtual (virgin) — no host bytes."""
+        self._resident[:] = -1
+        self._resident[:self.D] = np.arange(self.D)
+        self._owner = np.arange(self.D, dtype=np.int64)
+        self._clock[:] = 0
+        self._tick = 0
+        self._rows = {}
+        lo, hi = self.D, self.L
+        touched = ((arr["w"][lo:hi] != 0) | (arr["cnt"][lo:hi] != 0)
+                   | np.asarray(arr["v_live"][lo:hi], bool))
+        idx = np.nonzero(touched)[0]
+        if not len(idx):
+            return
+        built = np.asarray(build_rows(
+            self.param, self.D,
+            np.asarray(arr["V"][lo:hi][idx], np.float32),
+            np.asarray(arr["Vg"][lo:hi][idx], np.float32),
+            arr["w"][lo:hi][idx], arr["z"][lo:hi][idx],
+            arr["sqrt_g"][lo:hi][idx], arr["cnt"][lo:hi][idx],
+            np.asarray(arr["v_live"][lo:hi][idx], bool)))
+        for j, s in enumerate(idx):
+            self._rows[int(lo + s)] = built[j]
+
+
+def route_payload(tier: Optional[ColdTier], payload):
+    """Rewrite a packed host payload through the cold tier before H2D
+    staging (learners/sgd.py _stage_payload): the slots section becomes
+    device rows (promoting as needed), the index/cols cells route
+    through the position permutation, and the per-position counts
+    section re-orders with its slots. Pass-through when the tier is
+    off. ``panel_raw``/``panel_chunked`` are rejected — the learner
+    forces device_dedup and stream_chunks off while the tier is on."""
+    if tier is None:
+        return payload
+    kind = payload[0]
+    if kind == "panel":
+        _, i32, f32, binary, b_cap, width, u_cap = payload
+        cells = b_cap * width
+        slot_off = cells
+        vals_n = 0 if binary else cells
+    elif kind == "coo":
+        _, i32, f32, binary, b_cap, nnz_cap, u_cap = payload
+        slot_off = 2 * nnz_cap
+        vals_n = 0 if binary else nnz_cap
+    else:
+        raise ValueError(
+            f"cold tier cannot route payload kind {kind!r} "
+            "(device_dedup / stream_chunks must be off under "
+            "cold_tier_rows > 0)")
+    routed, order, perm = tier.route(i32[slot_off:slot_off + u_cap])
+    i32 = i32.copy()
+    i32[slot_off:slot_off + u_cap] = routed
+    if kind == "panel":
+        i32[:cells] = perm[i32[:cells]].astype(np.int32)
+    else:
+        i32[nnz_cap:2 * nnz_cap] = \
+            perm[i32[nnz_cap:2 * nnz_cap]].astype(np.int32)
+    counts_off = vals_n + 3 * b_cap
+    if len(f32) >= counts_off + u_cap:
+        f32 = f32.copy()
+        f32[counts_off:counts_off + u_cap] = \
+            f32[counts_off:counts_off + u_cap][order]
+    return (kind, i32, f32, binary, b_cap, payload[5], u_cap)

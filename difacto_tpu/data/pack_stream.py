@@ -148,7 +148,8 @@ def prepare_hashed(shapes: ShapeSchedule, hash_capacity: int, blk,
                    want_counts: bool, fill_counts: bool, dim_min: int,
                    job: str, b_cap: Optional[int] = None,
                    stream_chunk: bool = False,
-                   device_dedup: bool = False):
+                   device_dedup: bool = False,
+                   admit=None):
     """Producer batch preparation for the hashed store: ONE int32
     np.unique collapses localization (Localizer::Compact), key->slot
     mapping, and collision dedup, then the batch packs into the
@@ -169,12 +170,23 @@ def prepare_hashed(shapes: ShapeSchedule, hash_capacity: int, blk,
     panel-shaped TRAINING batches past the count push (fill_counts
     forces the host path: counts need the host inverse) — COO-shaped
     batches fall back to host dedup. The u-cap is sized with a +1
-    margin because pad cells introduce the TRASH lane on device."""
+    margin because pad cells introduce the TRASH lane on device.
+
+    ``admit`` (capacity/sketch.AdmissionFilter, ISSUE 19): count-min
+    admission over the hashed token stream — unadmitted occurrences
+    remap to the OOB sentinel (== hash_capacity) and, being the largest
+    "slot", sort LAST among the real slots; the sentinel lane is dropped
+    below so the unique declaration stays truthful (cells referencing it
+    fall onto the first OOB pad lane: gathers zeros, scatter dropped).
+    Admission forces the host-dedup path — the sentinel cannot ride raw
+    device lanes (the on-device sorter would give it a real lane)."""
     from ..base import reverse_bytes
     from ..store.local import hash_slots, pad_slots_oob
 
     tok = hash_slots(reverse_bytes(blk.index), hash_capacity)
-    if device_dedup and not fill_counts:
+    if admit is not None:
+        tok = admit.filter(tok)
+    if admit is None and device_dedup and not fill_counts:
         from ..ops.batch import pack_panel_raw, panel_width
         b_cap_raw = b_cap or shapes.cap(job + ".b", blk.size, dim_min)
         cblk = dataclasses.replace(blk, index=tok.astype(np.uint32))
@@ -194,9 +206,20 @@ def prepare_hashed(shapes: ShapeSchedule, hash_capacity: int, blk,
     else:
         slots, inverse = np.unique(tok, return_inverse=True)
         counts = np.zeros(0, np.float32) if want_counts else None
+    if admit is not None and len(slots) and slots[-1] == admit.sentinel:
+        # drop the sentinel lane: cells that referenced it now index the
+        # first OOB pad position instead (pad value = hash_capacity +
+        # position, pad_slots_oob) — still a zero-gather, dropped-scatter
+        # lane, and the slots section stays unique
+        slots = slots[:-1]
+        if fill_counts:
+            counts = counts[:-1]
     cblk = dataclasses.replace(blk, index=inverse.astype(np.uint32))
     n_uniq = len(slots)
-    u_cap = shapes.cap(job + ".u", n_uniq)
+    # +1 under admission: cells whose token was unadmitted reference
+    # position n_uniq, which must exist as an OOB pad lane even when the
+    # sticky cap is otherwise exactly full
+    u_cap = shapes.cap(job + ".u", n_uniq + (1 if admit is not None else 0))
     b_cap = b_cap or shapes.cap(job + ".b", blk.size, dim_min)
     padded = pad_slots_oob(slots.astype(np.int32), u_cap, hash_capacity)
     return pack_payload(shapes, cblk, n_uniq, padded, b_cap, dim_min,
@@ -262,6 +285,12 @@ class StreamSpec:
     # ship raw hashed token lanes; the jit step dedups on device
     # (prepare_hashed device_dedup — ISSUE 13)
     device_dedup: bool = False
+    # count-min admission threshold + sketch seed base (ISSUE 19,
+    # capacity/sketch.make_admission): workers rebuild the SAME
+    # per-(seed, epoch, part) filter the thread-mode producer builds, so
+    # both transports admit identical token sets
+    admit_min_count: int = 0
+    admit_seed: int = 0
     caps: dict = field(default_factory=dict)
     # the consumer's trace id (obs/trace.py): spawned workers adopt it so
     # their parse/pack spans join the parent's timeline in one trace file
@@ -342,6 +371,9 @@ def spec_iter(spec: StreamSpec, part_i: int) -> Iterator:
                 spec.job, spec.b_cap, stream_chunk=spec.stream_chunk))
         return
     from .batch_reader import BatchReader
+    from ..capacity.sketch import make_admission
+    admit = make_admission(spec.hash_capacity, spec.admit_min_count,
+                           spec.admit_seed, spec.epoch, g_idx)
     reader = BatchReader(spec.data_in, spec.data_format, g_idx, g_num,
                          spec.batch_size, spec.batch_size * spec.shuffle,
                          spec.neg_sampling,
@@ -353,4 +385,4 @@ def spec_iter(spec: StreamSpec, part_i: int) -> Iterator:
             prepare_hashed, shapes, spec.hash_capacity, blk,
             spec.want_counts, spec.fill_counts, spec.dim_min, spec.job,
             spec.b_cap, stream_chunk=spec.stream_chunk,
-            device_dedup=spec.device_dedup))
+            device_dedup=spec.device_dedup, admit=admit))

@@ -743,6 +743,14 @@ class SGDLearner(Learner):
                      k, train_prog.text(), train_prog.nnz_w,
                      train_prog.penalty)
 
+            # occupancy-pressure eviction (ISSUE 19, evict_occupancy):
+            # epoch boundary only — one full-table column read, and the
+            # dispatch queue is drained so demotes cannot race a step
+            n_evicted = self.store.maybe_evict()
+            if n_evicted:
+                log.info("epoch[%d] evicted %d rows under occupancy "
+                         "pressure", k, n_evicted)
+
             val_prog = Progress()
             if p.data_val:
                 self._run_epoch(k, K_VALIDATION, val_prog)
@@ -778,9 +786,11 @@ class SGDLearner(Learner):
         if p.model_out:
             log.info("saving final model...")
             self.store.save(self._model_name(p.model_out, -1), p.has_aux)
-        if self.store.fs_count > 1:
+        if self.store.fs_count > 1 or self.store.hashed:
             # per-shard occupancy gauges (docs/observability.md): one
-            # full-table host read at run end, never per step
+            # full-table host read at run end, never per step. Hashed
+            # stores publish even unsharded — the capacity levers'
+            # occupancy/tier digest (tools/obs_report.py) reads these
             self.store.publish_shard_stats()
         self.stop()
 
@@ -1487,7 +1497,8 @@ class SGDLearner(Learner):
                         dim_min: int, job: str,
                         b_cap: Optional[int] = None,
                         stream_chunk: bool = False,
-                        device_dedup: bool = False):
+                        device_dedup: bool = False,
+                        admit=None):
         """Producer batch preparation for the hashed store — delegates to
         the shared pipeline definition (data/pack_stream.prepare_hashed)
         so the thread and process transports pack identically."""
@@ -1495,7 +1506,7 @@ class SGDLearner(Learner):
         return prepare_hashed(self._shapes, self.store.param.hash_capacity,
                               blk, want_counts, fill_counts, dim_min, job,
                               b_cap, stream_chunk=stream_chunk,
-                              device_dedup=device_dedup)
+                              device_dedup=device_dedup, admit=admit)
 
     def _pack_payload(self, cblk, n_lanes, padded, b_cap, dim_min: int,
                       job: str, counts=None,
@@ -1609,7 +1620,11 @@ class SGDLearner(Learner):
         p = self.param
         if (p.device_cache_mb <= 0
                 or job_type not in (K_TRAINING, K_VALIDATION)
-                or (job_type == K_TRAINING and p.neg_sampling != 1.0)):
+                or (job_type == K_TRAINING and p.neg_sampling != 1.0)
+                # a staged replay would freeze batch->device-row routes
+                # that later promotes/demotes invalidate — tiered runs
+                # re-route every batch at staging time instead
+                or self.store.tier is not None):
             return None
         if not hasattr(self, "_dev_caches"):
             self._dev_caches = {}
@@ -1996,8 +2011,14 @@ class SGDLearner(Learner):
         # Opt-in — see SGDLearnerParam.stream_chunks for the core math.
         cache_may_stage = (cache is not None and cache.alive
                            and not cache.frozen)
+        # the cold tier rewrites packed payloads at staging time
+        # (capacity/tier.route_payload): the chunked layout has no
+        # rewritable index cells and raw device lanes bypass the host
+        # slots section entirely, so both producer fast paths force off
+        # while the tier routes
+        tier_on = self.store.tier is not None
         stream_chunk = (is_train and hashed_fast and p.stream_chunks
-                        and not cache_may_stage)
+                        and not cache_may_stage and not tier_on)
         # on-device unique-key dedup (ISSUE 13): raw token lanes +
         # in-step sort — streamed hashed training only, past the
         # epoch-0 count push (prepare_hashed also guards fill_counts),
@@ -2006,7 +2027,7 @@ class SGDLearner(Learner):
         # host inverse). See SGDLearnerParam.device_dedup.
         device_dedup = (is_train and hashed_fast and p.device_dedup
                         and not stream_chunk and not cache_may_stage
-                        and not push_cnt)
+                        and not push_cnt and not tier_on)
 
         from ..data.pack_stream import timed_reader
         from ..obs import trace
@@ -2048,6 +2069,15 @@ class SGDLearner(Learner):
                     else:
                         yield ("compact", sub, (sub, uniq, cnts))
                 return
+            # count-min admission over the streamed ingest (ISSUE 19):
+            # per-(seed, epoch, global part) filter, the thread-mode
+            # twin of spec_iter's — training passes only (eval reads
+            # whatever the table holds)
+            from ..capacity.sketch import make_admission
+            admit = make_admission(
+                self.store.param.hash_capacity,
+                self.store.param.admit_min_count,
+                self.store.param.seed, epoch, g_idx) if is_train else None
             reader = self._make_reader(job_type, epoch, g_idx, g_num)
             for blk in timed_reader(reader, parse_c, part):
                 if hashed_fast:
@@ -2056,7 +2086,7 @@ class SGDLearner(Learner):
                         push_cnt, dim_min, job,
                         b_cap_train if is_train else None,
                         stream_chunk=stream_chunk,
-                        device_dedup=device_dedup))
+                        device_dedup=device_dedup, admit=admit))
                 else:
                     yield ("compact", blk, packed(
                         part, compact, blk, need_counts=push_cnt))
@@ -2093,6 +2123,8 @@ class SGDLearner(Learner):
                 dim_min=dim_min, job=job, b_cap=b_cap_train,
                 stream_chunk=stream_chunk, need_label=False,
                 device_dedup=device_dedup,
+                admit_min_count=self.store.param.admit_min_count,
+                admit_seed=self.store.param.seed,
                 caps=self._shapes.snapshot(),
                 trace_id=trace.trace_id())
             slot_mb = p.ring_slot_mb or max(
@@ -2427,8 +2459,16 @@ class SGDLearner(Learner):
         double-buffer: batch k+1's transfer overlaps batch k's step.
         Counted into stage_seconds_total{stage=transfer}; the later
         jnp.asarray in _dispatch_prepared is an identity on the staged
-        arrays."""
+        arrays.
+
+        The single tier-routing chokepoint (ISSUE 19): with a cold tier
+        on, the payload's logical slots become device hot rows here —
+        promotes/demotes ride this same dispatch thread, between the
+        previous step's enqueue and this batch's H2D copies."""
         t0 = time.perf_counter()
+        if self.store.tier is not None and payload[0] in ("panel", "coo"):
+            from ..capacity.tier import route_payload
+            payload = route_payload(self.store.tier, payload)
         if payload[0] == "panel_chunked":
             (_, i32, f32, (ci, cl, cv), binary, b_cap, d2, u_cap) = payload
             out = ("panel_chunked", jnp.asarray(i32), jnp.asarray(f32),

@@ -176,6 +176,48 @@ def dedup_tokens(tok: jnp.ndarray, u_cap: int, capacity: int
     return slots, inverse, n
 
 
+# ------------------------------------------------------- quantized slots
+# Per-row symmetric quantization of the fused-row embedding halves
+# (capacity lever (a), difacto_tpu/capacity/): codes live in an int8
+# container (fp8 bit patterns are bitcast into it — one table dtype for
+# both kinds), the per-row f32 scale rides the spare scalar lanes of the
+# SAME fused row (updaters/sgd_updater.pack_scal lanes 5/6), so the hot
+# path stays exactly one gather + one scatter: dequant/requant are
+# elementwise epilogue ops on the already-gathered tile, traced into the
+# pallas scatter kernel like the rest of row_epilogue.
+_Q_MAX = {"int8": 127.0, "fp8": 448.0}  # fp8 = float8_e4m3fn finite max
+
+
+def quant_half(x: jnp.ndarray, kind: str):
+    """f32 [n, m] half -> (int8 codes [n, m], f32 scale [n]).
+
+    Symmetric per-row scaling: ``scale = max|row| / qmax`` (1.0 for
+    all-zero rows so the dequant is well-defined), int8 codes round to
+    [-127, 127], fp8 codes cast to float8_e4m3fn and bitcast into the
+    int8 container. Zero-padded lane columns encode as 0 either way."""
+    amax = jnp.max(jnp.abs(x), axis=1)
+    scale = jnp.where(amax > 0, amax / _Q_MAX[kind], 1.0)
+    y = x / scale[:, None]
+    if kind == "int8":
+        codes = jnp.clip(jnp.round(y), -127.0, 127.0).astype(jnp.int8)
+    else:
+        codes = jax.lax.bitcast_convert_type(
+            y.astype(jnp.float8_e4m3fn), jnp.int8)
+    return codes, scale
+
+
+def dequant_half(codes: jnp.ndarray, scale: jnp.ndarray, kind: str
+                 ) -> jnp.ndarray:
+    """Inverse of :func:`quant_half`: int8 container codes + per-row
+    scale -> f32 values."""
+    if kind == "int8":
+        f = codes.astype(jnp.float32)
+    else:
+        f = jax.lax.bitcast_convert_type(
+            codes, jnp.float8_e4m3fn).astype(jnp.float32)
+    return f * scale[:, None]
+
+
 # ------------------------------------------------------------- backends
 def gather_rows(table: jnp.ndarray, slots: jnp.ndarray,
                 backend: str = "jnp") -> jnp.ndarray:

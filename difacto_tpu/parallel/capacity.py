@@ -28,7 +28,8 @@ def capacity_scaling_report(fs_values: Optional[Sequence[int]] = None,
                             base_capacity: int = 1 << 12,
                             V_dim: int = 8, batch: int = 1024,
                             nnz_per_row: int = 8, steps: int = 4,
-                            v_dtype: str = "float32") -> dict:
+                            v_dtype: str = "float32",
+                            slot_dtype: str = "fp32") -> dict:
     """One leg per fs rung: {fs, hash_capacity, table_bytes_per_device,
     examples_per_sec} plus the cross-rung scaling summary. Rungs that
     exceed the visible device count are skipped (reported in
@@ -58,7 +59,7 @@ def capacity_scaling_report(fs_values: Optional[Sequence[int]] = None,
         cap = base_capacity * fs
         param = SGDUpdaterParam(V_dim=V_dim, V_threshold=0, lr=0.1,
                                 l1=1e-4, l2=1e-4, V_dtype=v_dtype,
-                                hash_capacity=cap)
+                                hash_capacity=cap, slot_dtype=slot_dtype)
         fns = make_fns(param)
         loss = create_loss("fm", V_dim)
         state = init_state(param, cap)

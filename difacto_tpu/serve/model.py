@@ -40,13 +40,15 @@ class ServingShardParam(Param):
     serve_mesh_fs: int = field(default=1, metadata=dict(lo=1))
 
 
-def store_geometry(param) -> Tuple[int, int]:
-    """(V_dim, hash_capacity) — the contract the compiled predict
-    programs were traced against (step.py make_predict_fn over
-    make_fns(param)). An in-place hot reload (serve/executor.py
+def store_geometry(param) -> Tuple[int, int, str]:
+    """(V_dim, hash_capacity, slot_dtype) — the contract the compiled
+    predict programs were traced against (step.py make_predict_fn over
+    make_fns(param)); slot_dtype changes the fused-row container dtype
+    and width (updaters/sgd_updater.row_layout), so a dtype flip is a
+    geometry change. An in-place hot reload (serve/executor.py
     swap_store) requires it unchanged; a mismatch routes through the
     blue/green executor swap (serve/reload.py) instead of a restart."""
-    return (param.V_dim, param.hash_capacity)
+    return (param.V_dim, param.hash_capacity, param.slot_dtype)
 
 
 def resolve_model_path(uri: str) -> str:
@@ -88,6 +90,12 @@ def model_meta(uri: str) -> dict:
             # per-key-range shard count of the save (store/local.py
             # _save_sharded); 1 = single-file table
             "fs_count": int(z["fs_count"]) if "fs_count" in files else 1,
+            # storage dtype of the fused slot rows the producing store
+            # ran with (ISSUE 19 capacity levers); arrays are always
+            # logical f32 — the stamp tells loaders to re-quantize so
+            # serving matches the training-time representation
+            "slot_dtype": (str(z["slot_dtype"])
+                           if "slot_dtype" in files else "fp32"),
         }
 
 
@@ -153,8 +161,16 @@ def _open_verified(path: str, kwargs: KWArgs
             "serve this data")
     sparam, kwargs = ServingShardParam.init_allow_unknown(list(kwargs))
     uparam, remain = SGDUpdaterParam.init_allow_unknown(kwargs)
+    # geometry comes from the checkpoint: V_dim/hash_capacity always,
+    # slot_dtype so a quantized trainer's model serves from the same
+    # 8-bit representation (weights-only; the load re-quantizes the
+    # logical f32 arrays through build_rows). cold_tier_rows is NEVER
+    # adopted: a serving replica holds the full logical table — the
+    # tier is a training-side residency optimisation
     uparam = dataclasses.replace(uparam, V_dim=meta["V_dim"],
-                                 hash_capacity=meta["hash_capacity"])
+                                 hash_capacity=meta["hash_capacity"],
+                                 slot_dtype=meta["slot_dtype"],
+                                 cold_tier_rows=0)
     mesh = None
     if sparam.serve_mesh_fs > 1:
         # fs-sharded serving: the same (dp, fs) mesh machinery as

@@ -129,6 +129,34 @@ class SlotStore:
         if initial_capacity is None:
             initial_capacity = param.init_capacity
         cap = param.hash_capacity if self.hashed else initial_capacity
+        # host-RAM cold tier (capacity/tier.py): the DEVICE table holds
+        # only hash_capacity - cold_tier_rows hot rows; logical slots
+        # route through the tier's residency map on every pull/push.
+        # Read-only (serving) stores ignore the knob — serving holds the
+        # full logical table (serve/model.py forces it to 0 anyway).
+        tiered = param.cold_tier_rows > 0 and not read_only
+        if tiered:
+            if not self.hashed:
+                raise ValueError("cold_tier_rows requires the hashed "
+                                 "store (hash_capacity > 0): dictionary "
+                                 "slots have no fixed logical space to "
+                                 "tier over")
+            if param.V_dim == 0:
+                raise ValueError("cold_tier_rows requires V_dim > 0: the "
+                                 "tier moves fused rows, the flat layout "
+                                 "has none")
+            if mesh is not None:
+                raise ValueError("cold_tier_rows is single-device only: "
+                                 "tier routing runs on the dispatch "
+                                 "thread against an unsharded table (use "
+                                 "mesh_fs for sharded capacity, or "
+                                 "combine fs with slot_dtype)")
+            if param.cold_tier_rows >= cap - 1:
+                raise ValueError(
+                    f"cold_tier_rows={param.cold_tier_rows} must leave at "
+                    f"least 2 hot rows of hash_capacity={cap} (trash row "
+                    "+ one working row)")
+            cap = cap - param.cold_tier_rows
         if self.fs_count > 1:
             # uneven NamedShardings are a jax error at device_put time —
             # fail at construction with the knob to fix (doubling growth
@@ -137,6 +165,10 @@ class SlotStore:
             from ..parallel import validate_fs_capacity
             validate_fs_capacity(cap, self.fs_count)
         self.state: SGDState = self._place(init_state(param, cap))
+        self.tier = None
+        if tiered:
+            from ..capacity.tier import ColdTier
+            self.tier = ColdTier(self)
 
     @property
     def fs_count(self) -> int:
@@ -285,10 +317,20 @@ class SlotStore:
         # collisions) — dedup to the sorted unique slot set and remap the
         # returned rows back to the caller's key order, mirroring push
         slots_np, remap, _ = self.map_keys_dedup(keys)
+        perm = None
+        if self.tier is not None:
+            # logical slots -> device hot rows (promoting cold rows);
+            # gather results come back in routed order, perm maps them
+            # to the sorted-slot order the remap step expects
+            slots_np, _, perm = self.tier.route(slots_np)
         w, V, vmask = self.fns.get_rows(self.state, jnp.asarray(slots_np))
         w = np.asarray(w)
         V = None if V is None else np.asarray(V)
         vmask = None if vmask is None else np.asarray(vmask)
+        if perm is not None:
+            w = w[perm]
+            V = None if V is None else V[perm]
+            vmask = None if vmask is None else vmask[perm]
         if remap is not None:
             w = w[remap]
             V = None if V is None else V[remap]
@@ -319,6 +361,17 @@ class SlotStore:
                 vm = np.zeros(n, dtype=np.float32)
                 np.maximum.at(vm, remap, np.asarray(vmask, np.float32))
                 vmask = vm
+        if self.tier is not None:
+            # route to device rows and carry the per-slot values along
+            # (order[j] = slot position now at routed position j); a
+            # degraded slot (promote fault) lands on an OOB lane whose
+            # scatter is dropped — that update is lost, the row is not
+            slots_np, order, _ = self.tier.route(slots_np)
+            gw = np.asarray(gw)[order]
+            if gV is not None:
+                gV = np.asarray(gV)[order]
+            if vmask is not None:
+                vmask = np.asarray(vmask)[order]
         slots = jnp.asarray(slots_np)
         if val_type == K_FEACOUNT:
             self.state = self.fns.apply_count(self.state, slots,
@@ -357,7 +410,8 @@ class SlotStore:
         each host (dp replicates across hosts), so every piece is locally
         addressable."""
         from ..parallel.multihost import to_local_numpy
-        from ..updaters.sgd_updater import col_V, col_Vg, scal_cols
+        from ..updaters.sgd_updater import (col_V, col_Vg, emb_cols_f32,
+                                            quantized, scal_cols)
         # build and fetch ONLY what the caller writes: the device->host
         # link is the cost (~8 MB/s tunneled; a full 4.2M-row V16 state
         # is ~600 MB), a non-aux save/dump never touches z/sqrt_g/Vg,
@@ -366,10 +420,18 @@ class SlotStore:
         # five scalar columns, so it always runs)
         w, zz, sg, cnt, live = scal_cols(self.param, state)
         cols = {"w": w, "z": zz, "sqrt_g": sg, "cnt": cnt, "v_live": live}
-        if keys is None or "V" in keys:
-            cols["V"] = col_V(self.param, state)
-        if keys is None or "Vg" in keys:
-            cols["Vg"] = col_Vg(self.param, state)
+        if quantized(self.param):
+            # 8-bit rows hold codes, not values: the host view must
+            # dequantize through the per-row scale lanes so checkpoints
+            # and dumps stay layout-independent logical f32
+            if keys is None or "V" in keys or "Vg" in keys:
+                Vf, Vgf = emb_cols_f32(self.param, state)
+                cols["V"], cols["Vg"] = Vf, Vgf
+        else:
+            if keys is None or "V" in keys:
+                cols["V"] = col_V(self.param, state)
+            if keys is None or "Vg" in keys:
+                cols["Vg"] = col_Vg(self.param, state)
         if keys is not None:
             cols = {f: cols[f] for f in keys}
         d = {f: to_local_numpy(a) for f, a in cols.items()}
@@ -379,6 +441,124 @@ class SlotStore:
             if f in d:
                 d[f] = d[f].astype(np.float32)
         return d
+
+    def _logical_np(self, keys: Optional[Tuple[str, ...]] = None) -> dict:
+        """_state_np over the LOGICAL slot space: identical to the device
+        view for untiered stores; with a cold tier the [device_rows]
+        columns expand to the full [hash_capacity] rows (hot rows at
+        their owning slot, demoted rows decoded from their host bytes,
+        virgin tail rows with their deterministic V init) — the dense
+        view every checkpoint/dump writes, so artifacts never depend on
+        the tier's residency at save time."""
+        st = self._state_np(self.state, keys=keys)
+        if self.tier is not None:
+            st = self.tier.logical_cols(st)
+        return st
+
+    def maybe_evict(self) -> int:
+        """Occupancy-pressure eviction (``evict_occupancy`` knob): when
+        the occupied fraction of device rows exceeds the threshold,
+        demote the lowest-count occupied rows until occupancy drops to
+        0.9x the threshold. With the cold tier on, evicted rows move to
+        host RAM and stay fully addressable (a pure capacity lever);
+        without it their FTRL/AdaGrad scalars reset to virgin (the V
+        codes and quant scales survive, masked by live=False). COLD
+        path — epoch boundaries (learners/sgd.py), never the dispatch
+        loop. Returns rows evicted; counted into
+        ``store_evictions_total``."""
+        thr = self.param.evict_occupancy
+        if thr <= 0:
+            return 0
+        st = self._state_np(self.state, keys=("w", "cnt", "v_live"))
+        occupied = (st["w"] != 0) | (st["cnt"] != 0)
+        if self.param.V_dim > 0:
+            occupied |= np.asarray(st["v_live"], bool)
+        occupied[TRASH_SLOT] = False
+        cap = self.state.capacity
+        n_occ = int(occupied.sum())
+        if n_occ / max(cap - 1, 1) <= thr:
+            return 0
+        target = int(0.9 * thr * (cap - 1))
+        n_evict = n_occ - target
+        rows = np.nonzero(occupied)[0]
+        order = np.argsort(st["cnt"][rows], kind="stable")
+        victims = np.sort(rows[order[:n_evict]])
+        if self.tier is not None:
+            n = self.tier.demote_rows(victims)
+        else:
+            n = self._reset_rows(victims)
+        if n:
+            from ..obs import REGISTRY
+            REGISTRY.counter(
+                "store_evictions_total",
+                "table rows evicted under occupancy pressure "
+                "(evict_occupancy)").inc(n)
+        return n
+
+    def _reset_rows(self, victims: np.ndarray) -> int:
+        """Reset the FTRL/AdaGrad scalars of the given sorted device
+        rows to virgin (w=z=sqrt_g=cnt=0, live=False) — the no-tier
+        eviction: the rows stay allocated (the hashed table is dense)
+        but stop contributing to predictions and restart their FTRL
+        trajectory on next touch. Embedding codes and quant scales are
+        left in place; live=False masks them."""
+        n = len(victims)
+        if n == 0:
+            return 0
+        from ..updaters.sgd_updater import pack_scal, row_layout, scal_f32
+        from ..ops import fused
+        if self.param.V_dim == 0:
+            vj = jnp.asarray(victims)
+            st = self.state
+            self.state = self._place(st._replace(
+                w=st.w.at[vj].set(0.0), z=st.z.at[vj].set(0.0),
+                sqrt_g=st.sqrt_g.at[vj].set(0.0),
+                cnt=st.cnt.at[vj].set(0.0),
+                v_live=st.v_live.at[vj].set(False)))
+            return n
+        _, _, _, off = row_layout(self.param, self.state.capacity)
+        from ..ops.batch import bucket
+        pad = pad_slots_oob(victims.astype(np.int32), bucket(n),
+                            self.state.capacity)
+        sl = jnp.asarray(pad)
+        rows = fused.gather_rows(self.state.VVg, sl)
+        f = scal_f32(rows[:, off:])
+        zero = jnp.zeros(rows.shape[0], jnp.float32)
+        scal = pack_scal(zero, zero, zero, zero,
+                         jnp.zeros(rows.shape[0], bool), rows.dtype,
+                         scale_V=f[:, 5], scale_Vg=f[:, 6])
+        out = jnp.concatenate([rows[:, :off], scal], axis=1)
+        self.state = self._place(self.state._replace(
+            VVg=fused.scatter_rows(self.state.VVg, sl, out)))
+        return n
+
+    def capacity_stats(self) -> dict:
+        """Effective-capacity accounting of the three levers
+        (bench.py --capacity; docs/perf_notes.md "Table capacity"):
+        logical addressable rows vs what an fp32/no-tier table of the
+        SAME per-device byte budget would hold."""
+        import dataclasses
+        from ..updaters.sgd_updater import state_bytes
+        dev_rows = self.state.capacity
+        logical = self.param.hash_capacity if self.hashed else dev_rows
+        fs = self.fs_count
+        bytes_total = state_bytes(self.param, dev_rows)
+        base = dataclasses.replace(self.param, slot_dtype="fp32",
+                                   V_dtype="float32", cold_tier_rows=0)
+        base_bpr = state_bytes(base, dev_rows) / max(dev_rows, 1)
+        baseline_rows = bytes_total / max(base_bpr, 1e-9)
+        out = {
+            "slot_dtype": self.param.slot_dtype,
+            "logical_rows": logical,
+            "device_rows": dev_rows,
+            "table_bytes_per_device": bytes_total // fs,
+            "effective_rows_per_device": logical // fs,
+            "capacity_multiplier": round(logical / max(baseline_rows,
+                                                       1e-9), 3),
+        }
+        if self.tier is not None:
+            out["tier"] = self.tier.stats()
+        return out
 
     def _assemble_state(self, arr: dict, capacity: int) -> SGDState:
         """Inverse of _state_np: dict with logical-width V/Vg -> SGDState
@@ -437,11 +617,20 @@ class SlotStore:
             return self._save_sharded(path, saved, save_aux, epoch, keep,
                                       shards)
         if self.hashed:
-            st = self._state_np(self.state, keys=saved)
+            # logical view: a tiered store saves the full
+            # [hash_capacity]-row table (hot + host-RAM rows), so the
+            # artifact is residency-independent. slot_dtype /
+            # cold_tier_rows stamps travel for loaders (serve/model.py
+            # adopts the quantization, never the tier — serving holds
+            # the whole table); arrays are ALWAYS logical f32
+            st = self._logical_np(keys=saved)
             arrays = dict(hash_capacity=np.array(self.param.hash_capacity),
                           V_dim=np.array(self.param.V_dim),
                           save_aux=np.array(save_aux),
                           learner=np.array("sgd"),
+                          slot_dtype=np.array(self.param.slot_dtype),
+                          cold_tier_rows=np.array(
+                              self.param.cold_tier_rows),
                           **{k: st[k] for k in saved})
             n = int((st["w"] != 0).sum())
         else:
@@ -460,6 +649,7 @@ class SlotStore:
                 save_aux=np.array(save_aux),
                 V_dim=np.array(self.param.V_dim),
                 learner=np.array("sgd"),
+                slot_dtype=np.array(self.param.slot_dtype),
             )
             if save_aux:
                 arrays.update(z=st["z"][slots], sqrt_g=st["sqrt_g"][slots],
@@ -490,13 +680,15 @@ class SlotStore:
         from ..parallel import fs_shard_bounds
         cap = self.param.hash_capacity
         bounds = fs_shard_bounds(cap, shards)
-        st = self._state_np(self.state, keys=saved)
+        st = self._logical_np(keys=saved)
         gen = mft.next_generation(path)
         n = int((st["w"] != 0).sum())
         geom = dict(hash_capacity=np.array(cap),
                     V_dim=np.array(self.param.V_dim),
                     save_aux=np.array(save_aux),
                     learner=np.array("sgd"),
+                    slot_dtype=np.array(self.param.slot_dtype),
+                    cold_tier_rows=np.array(self.param.cold_tier_rows),
                     fs_count=np.array(shards))
         for i, (lo, hi) in enumerate(bounds):
             man = {"learner": "sgd",
@@ -606,8 +798,7 @@ class SlotStore:
                         arr[k] = z[k]
                 nnz = int((np.asarray(arr["w"]) != 0).sum())
                 fin()
-                self.state = self._place(self._assemble_state(
-                    arr, self.param.hash_capacity))
+                self._commit_hashed(arr)
                 return nnz
             ck_vdim = int(z["V_dim"]) if "V_dim" in z.files else 0
             if ck_vdim != self.param.V_dim:
@@ -647,6 +838,23 @@ class SlotStore:
             self._slots = np.arange(1, n + 1, dtype=np.int64)
             self._next_slot = n + 1
         return n
+
+    def _commit_hashed(self, arr: dict) -> None:
+        """Commit loaded LOGICAL hashed-table columns [hash_capacity
+        rows]: untiered stores assemble the full table on device; a
+        tiered store splits at its device capacity — the hot prefix
+        becomes device state (residency resets to the identity prefix)
+        and the tail re-seeds the host tier (capacity/tier.load_cold).
+        Checkpoints therefore round-trip across tier configurations:
+        tiered saves load into untiered stores and vice versa."""
+        if self.tier is None:
+            self.state = self._place(self._assemble_state(
+                arr, self.param.hash_capacity))
+            return
+        dev_cap = self.tier.D
+        dev = {k: np.asarray(a)[:dev_cap] for k, a in arr.items()}
+        self.state = self._place(self._assemble_state(dev, dev_cap))
+        self.tier.load_cold(arr)
 
     def _load_sharded(self, path: str, fs_count: int, loaded,
                       weights_only: bool, verify: bool) -> int:
@@ -713,7 +921,7 @@ class SlotStore:
                         arr[k][lo:hi] = a
                 sfin()
         nnz = int((arr["w"] != 0).sum())
-        self.state = self._place(self._assemble_state(arr, cap))
+        self._commit_hashed(arr)
         return nnz
 
     def shard_stats(self) -> list:
@@ -761,7 +969,7 @@ class SlotStore:
         entries. need_reverse un-reverses ids back to the original space.
         Hashed mode has no id dictionary: the first column is the slot id
         and need_reverse is ignored."""
-        st = self._state_np(self.state, keys=("w", "v_live", "V") + (
+        st = self._logical_np(keys=("w", "v_live", "V") + (
             ("sqrt_g", "z", "Vg") if dump_aux else ()))
         if self.hashed:
             keep = st["w"] != 0

@@ -101,6 +101,39 @@ class SGDUpdaterParam(Param):
     fused_kernel: str = field(default="auto",
                               metadata=dict(enum=["auto", "pallas",
                                                   "jnp", "off"]))
+    # ---- table-capacity levers (difacto_tpu/capacity/; docs/perf_notes
+    # "Table capacity"). All default OFF: fp32 + admit-all + no tier is
+    # byte-identical to the pre-capacity trajectory.
+    # Storage dtype of the fused slot rows. "fp32" = full precision (the
+    # container still follows the legacy V_dtype knob, so existing bf16
+    # configs are untouched); "bf16" forces the bfloat16 container;
+    # "int8"/"fp8" store BOTH embedding halves as 8-bit codes in an int8
+    # container with per-row f32 scale factors riding the spare scalar
+    # lanes — 4x (2x vs bf16) more rows per HBM byte, with dequant/
+    # requant folded into the fused row epilogue so the hot path stays
+    # one gather + one scatter (ops/fused.quant_half). V_dim > 0 only
+    # (the flat layout has no fused row to quantize).
+    slot_dtype: str = field(default="fp32",
+                            metadata=dict(enum=["fp32", "bf16",
+                                                "int8", "fp8"]))
+    # Frequency-adaptive admission (capacity/sketch.py): a hashed token
+    # must reach this count-min-sketch estimate in the producer's ingest
+    # stream before it is admitted to the table; rarer tokens route to
+    # an OOB lane (gathers zeros, scatter dropped). 0 = admit all. The
+    # TPU-side analog of the reference's frequency filter: rare features
+    # never cost a slot.
+    admit_min_count: int = field(default=0, metadata=dict(lo=0))
+    # Occupancy-pressure eviction (SlotStore.maybe_evict, cold path):
+    # when the occupied fraction of table rows exceeds this threshold,
+    # the lowest-count rows are evicted (demoted to the cold tier when
+    # it is on, else their FTRL/AdaGrad scalars reset to virgin) until
+    # occupancy drops to 0.9x the threshold. 0 = off.
+    evict_occupancy: float = field(default=0.0, metadata=dict(lo=0, hi=1))
+    # Host-RAM cold tier (capacity/tier.py): the device table holds
+    # hash_capacity - cold_tier_rows HOT rows; the zipf tail lives in
+    # host RAM and rows promote/demote in batches on the dispatch
+    # thread. 0 = off. Hashed stores with V_dim > 0 only.
+    cold_tier_rows: int = field(default=0, metadata=dict(lo=0))
 
 
 class SGDState(NamedTuple):
@@ -140,7 +173,23 @@ class SGDState(NamedTuple):
         return self.VVg.shape[0]
 
 
+def quantized(param: SGDUpdaterParam) -> bool:
+    """True when the fused rows store 8-bit codes with per-row scales
+    (slot_dtype int8/fp8) — the layout where the embedding halves need a
+    dequant before use and a requant on write-back."""
+    return param.slot_dtype in ("int8", "fp8") and param.V_dim > 0
+
+
 def v_dtype(param: SGDUpdaterParam):
+    """Container dtype of the fused rows. slot_dtype=fp32 means "full
+    precision" and defers to the legacy V_dtype knob (so existing bf16
+    configs keep their exact layout); int8 AND fp8 share the int8
+    container (fp8 bit patterns bitcast in, ops/fused.quant_half)."""
+    if param.V_dim > 0:
+        if param.slot_dtype in ("int8", "fp8"):
+            return jnp.int8
+        if param.slot_dtype == "bf16":
+            return jnp.bfloat16
     return jnp.bfloat16 if param.V_dtype == "bfloat16" else jnp.float32
 
 
@@ -155,7 +204,7 @@ def v_half(param: SGDUpdaterParam, capacity: int) -> int:
     if k == 0 or not param.pad_v_rows:
         return k
     h = -(-k // 64) * 64
-    bytes_per_el = 2 if param.V_dtype == "bfloat16" else 4
+    bytes_per_el = np.dtype(v_dtype(param)).itemsize
     if capacity * 2 * h * bytes_per_el > param.pad_v_rows_max_mb << 20:
         return k
     return h
@@ -173,18 +222,22 @@ def fuse_vvg(V, Vg, h: int):
 
 
 # fused-row scalar section: the BYTES of f32[8] = (w, z, sqrt_g, cnt,
-# v_live-as-1.0/0.0, 3 spare) reinterpreted in the row's storage dtype —
-# 16 bfloat16 lanes or 8 f32 lanes. One contiguous minor-dim slice plus a
-# bulk bitcast_convert_type reads/writes the whole section (bit-exact for
-# bf16 storage: each f32 spans two adjacent lanes, low bits first), which
-# keeps XLA on the row-major layout — per-lane extraction with uint
-# shifts made layout assignment prefer a TRANSPOSED gather and insert a
-# full-table copy of the donated state every step (docs/perf_notes.md).
+# v_live-as-1.0/0.0, scale_V, scale_Vg, 1 spare) reinterpreted in the
+# row's storage dtype — 8 f32 lanes, 16 bfloat16 lanes, or 32 int8 lanes
+# (quantized slots). One contiguous minor-dim slice plus a bulk
+# bitcast_convert_type reads/writes the whole section (bit-exact: each
+# f32 spans 4/itemsize adjacent lanes, low bits first), which keeps XLA
+# on the row-major layout — per-lane extraction with uint shifts made
+# layout assignment prefer a TRANSPOSED gather and insert a full-table
+# copy of the donated state every step (docs/perf_notes.md). Lanes 5/6
+# carry the per-row quantization scales of the V/Vg halves when
+# slot_dtype is int8/fp8 (ops/fused.quant_half); exact 0.0 otherwise —
+# bit-identical to the old spare-lane zeros.
 SCAL_F32S = 8
 
 
 def scal_lanes(dtype) -> int:
-    return SCAL_F32S if dtype == jnp.float32 else 2 * SCAL_F32S
+    return SCAL_F32S * (4 // np.dtype(dtype).itemsize)
 
 
 def row_layout(param: SGDUpdaterParam, capacity: int
@@ -209,27 +262,43 @@ def row_layout(param: SGDUpdaterParam, capacity: int
     return k, h, Wx, Wx - ns
 
 
-def pack_scal(w, z, sqrt_g, cnt, live, dtype):
-    """f32 scalar columns + bool live -> [n, scal_lanes] of ``dtype``."""
-    f = jnp.stack([jnp.asarray(w, jnp.float32), jnp.asarray(z, jnp.float32),
+def pack_scal(w, z, sqrt_g, cnt, live, dtype, scale_V=None, scale_Vg=None):
+    """f32 scalar columns + bool live -> [n, scal_lanes] of ``dtype``.
+    ``scale_V``/``scale_Vg`` fill the quantization-scale lanes 5/6
+    (quantized slots); omitted they stay exact 0.0 — byte-identical to
+    the historical spare-lane zeros."""
+    wf = jnp.asarray(w, jnp.float32)
+    f = jnp.stack([wf, jnp.asarray(z, jnp.float32),
                    jnp.asarray(sqrt_g, jnp.float32),
                    jnp.asarray(cnt, jnp.float32),
                    jnp.asarray(live, jnp.float32),
-                   jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w)],
+                   jnp.zeros_like(wf) if scale_V is None
+                   else jnp.asarray(scale_V, jnp.float32),
+                   jnp.zeros_like(wf) if scale_Vg is None
+                   else jnp.asarray(scale_Vg, jnp.float32),
+                   jnp.zeros_like(wf)],
                   axis=1)
     if dtype == jnp.float32:
         return f
-    return jax.lax.bitcast_convert_type(f, jnp.bfloat16).reshape(
-        f.shape[0], 2 * SCAL_F32S)
+    n_per = 4 // np.dtype(dtype).itemsize
+    return jax.lax.bitcast_convert_type(f, dtype).reshape(
+        f.shape[0], n_per * SCAL_F32S)
+
+
+def scal_f32(lanes):
+    """[n, scal_lanes] scalar section (any container dtype) -> the
+    underlying f32[n, SCAL_F32S] matrix — columns (w, z, sqrt_g, cnt,
+    live, scale_V, scale_Vg, spare)."""
+    if lanes.dtype == jnp.float32:
+        return lanes
+    n_per = 4 // np.dtype(lanes.dtype).itemsize
+    return jax.lax.bitcast_convert_type(
+        lanes.reshape(lanes.shape[0], SCAL_F32S, n_per), jnp.float32)
 
 
 def unpack_scal(lanes):
     """[n, scal_lanes] scalar section -> (w, z, sqrt_g, cnt, live)."""
-    if lanes.dtype == jnp.float32:
-        f = lanes
-    else:
-        f = jax.lax.bitcast_convert_type(
-            lanes.reshape(lanes.shape[0], SCAL_F32S, 2), jnp.float32)
+    f = scal_f32(lanes)
     return f[:, 0], f[:, 1], f[:, 2], f[:, 3], f[:, 4] > 0
 
 
@@ -263,6 +332,21 @@ def col_Vg(param: SGDUpdaterParam, state: SGDState) -> jnp.ndarray:
     return state.VVg[:, h:h + k]
 
 
+def emb_cols_f32(param: SGDUpdaterParam, state: SGDState):
+    """Full-table LOGICAL f32 (V, Vg) columns — dequantized when the
+    rows store 8-bit codes (the per-row scales come from the scalar
+    lanes). The layout-independent view checkpoints, eval and growth
+    re-layout read; full-table pass, cold paths only."""
+    k, h, _, off = row_layout(param, state.capacity)
+    V, Vg = state.VVg[:, :k], state.VVg[:, h:h + k]
+    if not quantized(param):
+        return V.astype(jnp.float32), Vg.astype(jnp.float32)
+    from ..ops import fused
+    f = scal_f32(state.VVg[:, off:])
+    return (fused.dequant_half(V, f[:, 5], param.slot_dtype),
+            fused.dequant_half(Vg, f[:, 6], param.slot_dtype))
+
+
 def state_bytes(param: SGDUpdaterParam, capacity: int) -> int:
     """HBM bytes of the slot table at ``capacity`` rows — the number the
     fs-sharding capacity story is about: per-device residency is
@@ -274,7 +358,7 @@ def state_bytes(param: SGDUpdaterParam, capacity: int) -> int:
         # four f32 columns (w, z, sqrt_g, cnt) + bool v_live
         return capacity * (4 * 4 + 1)
     _, _, Wx, _ = row_layout(param, capacity)
-    return capacity * Wx * (2 if param.V_dtype == "bfloat16" else 4)
+    return capacity * Wx * np.dtype(v_dtype(param)).itemsize
 
 
 def gather_bytes(param: SGDUpdaterParam, capacity: int, u_cap: int) -> int:
@@ -287,7 +371,7 @@ def gather_bytes(param: SGDUpdaterParam, capacity: int, u_cap: int) -> int:
     if param.V_dim == 0:
         return u_cap * 3 * 4
     _, _, Wx, _ = row_layout(param, capacity)
-    return u_cap * Wx * (2 if param.V_dtype == "bfloat16" else 4)
+    return u_cap * Wx * np.dtype(v_dtype(param)).itemsize
 
 
 def set_all_live(param: SGDUpdaterParam, state: SGDState) -> SGDState:
@@ -295,8 +379,10 @@ def set_all_live(param: SGDUpdaterParam, state: SGDState) -> SGDState:
     if param.V_dim == 0:
         return state._replace(v_live=jnp.ones_like(state.v_live))
     _, _, _, off = row_layout(param, state.capacity)
-    w, z, sg, cnt, _ = unpack_scal(state.VVg[:, off:])
-    scal = pack_scal(w, z, sg, cnt, jnp.ones_like(w, bool), state.VVg.dtype)
+    f = scal_f32(state.VVg[:, off:])
+    scal = pack_scal(f[:, 0], f[:, 1], f[:, 2], f[:, 3],
+                     jnp.ones_like(f[:, 0], bool), state.VVg.dtype,
+                     scale_V=f[:, 5], scale_Vg=f[:, 6])
     return state._replace(
         VVg=jnp.concatenate([state.VVg[:, :off], scal], axis=1))
 
@@ -309,12 +395,21 @@ def build_rows(param: SGDUpdaterParam, capacity: int, V, Vg,
     cannot drift between sites."""
     _, h, Wx, off = row_layout(param, capacity)
     dt = v_dtype(param)
-    halves = fuse_vvg(jnp.asarray(V, jnp.float32),
-                      jnp.asarray(Vg, jnp.float32), h).astype(dt)
+    if quantized(param):
+        from ..ops import fused
+        Vc, sV = fused.quant_half(jnp.asarray(V, jnp.float32),
+                                  param.slot_dtype)
+        Vgc, sVg = fused.quant_half(jnp.asarray(Vg, jnp.float32),
+                                    param.slot_dtype)
+        halves = fuse_vvg(Vc, Vgc, h)
+    else:
+        sV = sVg = None
+        halves = fuse_vvg(jnp.asarray(V, jnp.float32),
+                          jnp.asarray(Vg, jnp.float32), h).astype(dt)
     scal = pack_scal(jnp.asarray(w, jnp.float32), jnp.asarray(z, jnp.float32),
                      jnp.asarray(sqrt_g, jnp.float32),
                      jnp.asarray(cnt, jnp.float32),
-                     jnp.asarray(live), dt)
+                     jnp.asarray(live), dt, scale_V=sV, scale_Vg=sVg)
     # in-pad layout (off < 2h): the scal section replaces the tail of the
     # Vg-half pad; appended layout: zero gap lanes between halves and scal
     if off <= 2 * h:
@@ -337,10 +432,21 @@ def init_state(param: SGDUpdaterParam, capacity: int) -> SGDState:
     V = (jax.random.uniform(key, (capacity, k), dtype=jnp.float32) - 0.5) \
         * param.V_init_scale
     _, _, Wx, _ = row_layout(param, capacity)
-    # all-zero scalar lanes already encode (w,z,sqrt_g,cnt,live) =
-    # (0,0,0,0,False) in both dtypes, so only the V block needs writing
-    T = jnp.zeros((capacity, Wx), v_dtype(param)
-                  ).at[:, :k].set(V.astype(v_dtype(param)))
+    if quantized(param):
+        # quantized rows need their per-row V scale in the scalar lanes
+        # (a zero scale would dequantize the init values to 0), so init
+        # routes through the full row builder
+        zcol = jnp.zeros(capacity, jnp.float32)
+        T = build_rows(param, capacity, V,
+                       jnp.zeros((capacity, k), jnp.float32),
+                       zcol, zcol, zcol, zcol,
+                       jnp.zeros(capacity, dtype=bool))
+    else:
+        # all-zero scalar lanes already encode (w,z,sqrt_g,cnt,live) =
+        # (0,0,0,0,False) in both dtypes, so only the V block needs
+        # writing
+        T = jnp.zeros((capacity, Wx), v_dtype(param)
+                      ).at[:, :k].set(V.astype(v_dtype(param)))
     empty = jnp.zeros(0, jnp.float32)
     return SGDState(w=empty, z=empty + 0, sqrt_g=empty + 0, cnt=empty + 0,
                     VVg=T, v_live=jnp.zeros(0, dtype=bool))
@@ -365,9 +471,9 @@ def grow_state(param: SGDUpdaterParam, state: SGDState, new_capacity: int
                                                             new_capacity):
         k, h, _, off = row_layout(param, old)
         w, z, sg, cnt, live = unpack_scal(state.VVg[:, off:])
+        Vf, Vgf = emb_cols_f32(param, state)
         state = state._replace(VVg=build_rows(
-            param, new_capacity, state.VVg[:, :k].astype(jnp.float32),
-            state.VVg[:, h:h + k].astype(jnp.float32), w, z, sg, cnt, live))
+            param, new_capacity, Vf, Vgf, w, z, sg, cnt, live))
     return SGDState(*(jnp.concatenate([a, jnp.asarray(b)[old:]], axis=0)
                       for a, b in zip(state, ext)))
 
@@ -400,28 +506,50 @@ def row_epilogue(param: SGDUpdaterParam, capacity: int, rows: jnp.ndarray,
     scatter drops."""
     k, h, _, off = row_layout(param, capacity)
     thr = float(param.V_threshold)
-    w, z, sg, cnt, live = unpack_scal(rows[:, off:])
+    q = quantized(param)
+    f = scal_f32(rows[:, off:])
+    w, z, sg, cnt, live = f[:, 0], f[:, 1], f[:, 2], f[:, 3], f[:, 4] > 0
+    # per-row quantization scales ride lanes 5/6 (exact 0.0 when the
+    # rows are not quantized — carried through bit-identically)
+    sV, sVg = f[:, 5], f[:, 6]
     w_new, z_new, sg_new = ftrl_w(w, z, sg, gw, param.l1, param.l2,
                                   param.lr, param.lr_beta)
     # lazy-V activation on the touched rows (the union of the
     # reference's two trigger sites re-evaluated after the update)
     live_new = live | ((w_new != 0) & (cnt > thr))
-    scal = pack_scal(w_new, z_new, sg_new, cnt, live_new, rows.dtype)
 
     if gV is not None:
-        V = rows[:, :k].astype(jnp.float32)
-        Vg = rows[:, h:h + k].astype(jnp.float32)
+        if q:
+            from ..ops import fused
+            V = fused.dequant_half(rows[:, :k], sV, param.slot_dtype)
+            Vg = fused.dequant_half(rows[:, h:h + k], sVg, param.slot_dtype)
+        else:
+            V = rows[:, :k].astype(jnp.float32)
+            Vg = rows[:, h:h + k].astype(jnp.float32)
         gv = gV + param.V_l2 * V
         Vg_new = jnp.sqrt(Vg * Vg + gv * gv)
         V_new = V - param.V_lr / (Vg_new + param.V_lr_beta) * gv
         # AdaGrad only touches rows whose embedding was PULLED this
         # batch (lens[i] > 1 semantics, sgd_updater.cc:91-96)
         upd = pull_vmask[:, None] > 0
-        emb = jnp.where(upd, fuse_vvg(V_new, Vg_new, h),
-                        rows[:, :2 * h].astype(jnp.float32)
-                        ).astype(rows.dtype)
+        if q:
+            # requant with FRESH per-row scales; both the codes and the
+            # scales are gated on pull_vmask so an untouched row keeps a
+            # consistent (codes, scale) pair
+            Vc, sV_new = fused.quant_half(V_new, param.slot_dtype)
+            Vgc, sVg_new = fused.quant_half(Vg_new, param.slot_dtype)
+            emb = jnp.where(upd, fuse_vvg(Vc, Vgc, h), rows[:, :2 * h])
+            um = pull_vmask > 0
+            sV = jnp.where(um, sV_new, sV)
+            sVg = jnp.where(um, sVg_new, sVg)
+        else:
+            emb = jnp.where(upd, fuse_vvg(V_new, Vg_new, h),
+                            rows[:, :2 * h].astype(jnp.float32)
+                            ).astype(rows.dtype)
     else:
         emb = rows[:, :2 * h]
+    scal = pack_scal(w_new, z_new, sg_new, cnt, live_new, rows.dtype,
+                     scale_V=sV, scale_Vg=sVg)
     # in-pad layout: scal replaces the tail of emb's own pad lanes;
     # appended layout: the gap lanes between are carried through
     if off <= 2 * h:
@@ -484,11 +612,19 @@ def make_fns(param: SGDUpdaterParam, mesh=None):
         sgd_updater.cc:34-58): the embedding is served only when live
         and not suppressed by ``l1_shrk`` (w == 0)."""
         _, _, _, off = _layout(state)
-        w, _, _, _, live = unpack_scal(rows[:, off:])
+        f = scal_f32(rows[:, off:])
+        w, live = f[:, 0], f[:, 4] > 0
         vmask = live
         if param.l1_shrk:
             vmask = vmask & (w != 0)
-        return w, rows[:, :param.V_dim], vmask.astype(jnp.float32)
+        if quantized(param):
+            # loss-side V must be real values, not codes: dequantize
+            # with the per-row scale riding lane 5 (f32 compute)
+            V = fused.dequant_half(rows[:, :param.V_dim], f[:, 5],
+                                   param.slot_dtype)
+        else:
+            V = rows[:, :param.V_dim]
+        return w, V, vmask.astype(jnp.float32)
 
     def get_rows(state: SGDState, slots: jnp.ndarray
                  ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
@@ -511,10 +647,14 @@ def make_fns(param: SGDUpdaterParam, mesh=None):
             return state._replace(cnt=cnt)
         _, _, _, off = _layout(state)
         rows = _gather(state.VVg, slots)
-        w, z, sg, cnt, live = unpack_scal(rows[:, off:])
+        f = scal_f32(rows[:, off:])
+        w, z, sg, cnt, live = f[:, 0], f[:, 1], f[:, 2], f[:, 3], f[:, 4] > 0
         cnt_new = cnt + counts
         live_new = live | ((w != 0) & (cnt_new > thr))
-        scal = pack_scal(w, z, sg, cnt_new, live_new, state.VVg.dtype)
+        # scale lanes 5/6 carried through — a count push must not zero a
+        # quantized row's dequant scales
+        scal = pack_scal(w, z, sg, cnt_new, live_new, state.VVg.dtype,
+                         scale_V=f[:, 5], scale_Vg=f[:, 6])
         out = jnp.concatenate([rows[:, :off], scal], axis=1)
         return state._replace(VVg=_scatter(state.VVg, slots, out))
 
@@ -570,7 +710,9 @@ def make_fns(param: SGDUpdaterParam, mesh=None):
         nnz = jnp.sum((w != 0).astype(jnp.float32))
         if has_V:
             live = live.at[TRASH_SLOT].set(False)
-            Vm = col_V(param, state).astype(jnp.float32) * live[:, None]
+            Vcol = (emb_cols_f32(param, state)[0] if quantized(param)
+                    else col_V(param, state).astype(jnp.float32))
+            Vm = Vcol * live[:, None]
             # quirk preserved: Evaluate charges l2 (not V_l2) on V
             penalty = penalty + jnp.sum(0.5 * l2 * Vm * Vm)
             nnz = nnz + jnp.sum(live) * param.V_dim

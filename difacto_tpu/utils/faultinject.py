@@ -49,7 +49,14 @@ run of a real cluster) arm through one environment variable:
   ``autoscale.spawn`` (the autoscaler's scale-up decision,
   serve/autoscale.py — ``err`` models the spawn path failing: no
   binary, no free port, quota; the decision is refused and counted in
-  ``autoscale_aborts_total`` while the control loop keeps measuring).
+  ``autoscale_aborts_total`` while the control loop keeps measuring),
+  ``store.demote`` (a cold-tier demotion batch, capacity/tier.py —
+  fired BEFORE the device fetch, so ``err`` leaves every victim row
+  resident and serving; the move is fetch-then-forget and a refused
+  demote loses nothing), ``store.promote`` (a cold-tier promotion
+  batch — fired before the device scatter; ``err`` keeps the missing
+  slots cold for this batch only, which reads zeros through the OOB
+  lanes, and the next touch retries the promote).
 - ``kind`` — what happens when the fault fires:
     - ``err``      raise :class:`FaultInjected` (an OSError, so IO call
                    sites treat it exactly like a real IO failure);

@@ -78,6 +78,13 @@ def drive_scan(fs: int, capacity: int, budget: int,
     capacity_scaling_report(fs_values=[fs], base_capacity=capacity // fs,
                             V_dim=4, batch=64, nnz_per_row=4, steps=1)
 
+    # quantized-slot leg (ISSUE 19): the SAME fs-sharded step with the
+    # int8 fused-row container — --check proves the dequant/requant
+    # epilogues introduce no table-axis collective under fs sharding
+    capacity_scaling_report(fs_values=[fs], base_capacity=capacity // fs,
+                            V_dim=4, batch=64, nnz_per_row=4, steps=1,
+                            slot_dtype="int8")
+
     if tau > 0:
         # bounded-delay leg: the SAME fs-sharded train step driven
         # through the real windowed pipeline (prefetch depth 2+τ) —

@@ -40,6 +40,12 @@ runs use it to force a scale-up mid-run and a drain after the peak.
 stays open-loop either way — the multiplier rides on the SCHEDULED
 arrival time, not on response progress.
 
+``--zipf-alpha A`` (flat and failover drivers) skews WHICH rows get
+sent: row ranks draw from a Zipf(A) law instead of the round-robin
+cycle, the popularity shape real key traffic has — the knob the
+capacity bench (bench.py --capacity) sweeps to measure the cold
+tier's hit rate under realistic skew.
+
 ``--label-rate R --label-delay-s D`` switches to the FEEDBACK driver
 (``run_loadgen_feedback``) for the online-learning loop
 (docs/serving.md "Continuous learning"): every arrival is sent as
@@ -95,16 +101,35 @@ def profile_qps(profile, qps: float, frac: float) -> float:
     return qps * anchors[-1][1]
 
 
+def make_picker(n: int, zipf_alpha: float, seed: int = 0):
+    """Row-index chooser for the senders: ``zipf_alpha <= 0`` cycles
+    round-robin (every row equally hot — the historical behavior);
+    ``zipf_alpha > 0`` draws ranks from a Zipf law ``p(r) ~ 1/r^alpha``
+    over the row set, the skewed key popularity real traffic has and
+    the shape the cold tier's hit-rate depends on (docs/perf_notes.md
+    "Table capacity"; bench.py --capacity sweeps two alphas). Seeded
+    and independent of the arrival-schedule RNG, so turning skew on
+    never perturbs the offered-rate schedule."""
+    if zipf_alpha <= 0.0 or n <= 1:
+        return lambda i: i % n
+    w = 1.0 / np.power(np.arange(1, n + 1, dtype=np.float64), zipf_alpha)
+    cdf = np.cumsum(w / w.sum())
+    rng = np.random.RandomState(seed ^ 0x5A1F)
+    return lambda i: int(np.searchsorted(cdf, rng.random_sample()))
+
+
 def run_loadgen(host: str, port: int, rows: Sequence[Line], qps: float,
                 duration_s: float, seed: int = 0,
                 recv_timeout: float = 30.0,
-                profile: str = "flat") -> dict:
+                profile: str = "flat", zipf_alpha: float = 0.0) -> dict:
     """Drive the server open-loop at ``qps`` for ``duration_s`` seconds,
     cycling through ``rows``; ``profile`` shapes the rate over the run
-    (:func:`profile_qps`). Returns the latency/throughput report."""
+    (:func:`profile_qps`), ``zipf_alpha`` skews which rows get sent
+    (:func:`make_picker`). Returns the latency/throughput report."""
     rows = [_to_bytes(r) for r in rows]
     if not rows:
         raise ValueError("loadgen needs at least one request row")
+    pick = make_picker(len(rows), zipf_alpha, seed)
     rng = np.random.RandomState(seed)
     sock = socket.create_connection((host, port), timeout=recv_timeout)
     try:
@@ -132,7 +157,7 @@ def run_loadgen(host: str, port: int, rows: Sequence[Line], qps: float,
             with ts_lock:
                 send_ts.append(time.monotonic())
             try:
-                sock.sendall(rows[i % len(rows)])
+                sock.sendall(rows[pick(i)])
             except OSError:
                 # the server dropped the connection (drain/shutdown
                 # mid-run): stop offering, let the receiver tally what
@@ -377,7 +402,8 @@ def run_loadgen_failover(endpoints, rows: Sequence[Line], qps: float,
                          duration_s: float, seed: int = 0,
                          retries: int = 8, chunk: int = 64,
                          timeout: float = 30.0, blacklist=None,
-                         profile: str = "flat") -> dict:
+                         profile: str = "flat",
+                         zipf_alpha: float = 0.0) -> dict:
     """Open-loop schedule over the failover ``ServeClient``: due rows
     are pipelined in chunks of at most ``chunk``; a dropped replica is
     absorbed by the client (reconnect / next endpoint / resend tail),
@@ -394,6 +420,7 @@ def run_loadgen_failover(endpoints, rows: Sequence[Line], qps: float,
     rows = [_to_bytes(r) for r in rows]
     if not rows:
         raise ValueError("loadgen needs at least one request row")
+    pick = make_picker(len(rows), zipf_alpha, seed)
     rng = np.random.RandomState(seed)
     client = ServeClient(endpoints=endpoints, retries=retries,
                          backoff_s=0.02, backoff_max_s=0.5,
@@ -408,7 +435,7 @@ def run_loadgen_failover(endpoints, rows: Sequence[Line], qps: float,
             due = []
             now = time.monotonic()
             while t_next <= now and t_next < t_end and len(due) < chunk:
-                due.append((rows[i % len(rows)], t_next))
+                due.append((rows[pick(i)], t_next))
                 i += 1
                 t_next += rng.exponential(1.0 / profile_qps(
                     profile, qps, (t_next - t_start) / duration_s))
@@ -475,6 +502,11 @@ def main() -> None:
                     choices=sorted(PROFILES),
                     help="shape of the offered rate over the run: "
                          "flat, or the diurnal trough/peak cycle")
+    ap.add_argument("--zipf-alpha", type=float, default=0.0,
+                    help="skew the row-selection distribution: 0 cycles "
+                         "round-robin, >0 draws row ranks from a "
+                         "Zipf(alpha) law — the popularity shape the "
+                         "cold-tier hit rate depends on")
     ap.add_argument("--label-rate", type=float, default=0.0,
                     help="feedback mode: report each row's own label "
                          "back for this fraction of #score'd rows")
@@ -500,7 +532,8 @@ def main() -> None:
         rep = run_loadgen_failover(
             args.endpoints, rows, args.qps, args.duration,
             seed=args.seed, retries=args.retries,
-            blacklist=args.blacklist or None, profile=args.profile)
+            blacklist=args.blacklist or None, profile=args.profile,
+            zipf_alpha=args.zipf_alpha)
         print(json.dumps(rep))
         # the per-endpoint summary, one human line each: which replica
         # answered the rows, who failed over, who got ejected
@@ -518,7 +551,8 @@ def main() -> None:
     else:
         print(json.dumps(run_loadgen(args.host, args.port, rows, args.qps,
                                      args.duration, seed=args.seed,
-                                     profile=args.profile)))
+                                     profile=args.profile,
+                                     zipf_alpha=args.zipf_alpha)))
 
 
 if __name__ == "__main__":

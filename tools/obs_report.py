@@ -158,6 +158,45 @@ def report_fleet(snap: dict) -> None:
     print()
 
 
+def report_capacity(snap: dict) -> None:
+    """Table-capacity digest (docs/observability.md): the cold tier's
+    residency traffic (``store_tier_*``), admission drops, occupancy
+    eviction and the per-shard occupancy gauges in one block, plus the
+    derived tier hit-rate — the first read when judging whether
+    ``cold_tier_rows`` / ``admit_min_count`` are sized right for the
+    key skew (docs/perf_notes.md "Table capacity")."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+
+    def _total(section, name):
+        series = section.get(name)
+        if not series:
+            return None
+        return sum(series.values())
+
+    rows = []
+    for name in ("store_tier_hits_total", "store_tier_misses_total",
+                 "store_tier_promotes_total", "store_tier_demotes_total",
+                 "store_evictions_total", "store_admit_drops_total"):
+        v = _total(counters, name)
+        if v is not None:
+            rows.append((name, v))
+    hits = _total(counters, "store_tier_hits_total")
+    misses = _total(counters, "store_tier_misses_total")
+    if hits is not None and misses is not None and hits + misses > 0:
+        rows.append(("tier_hit_rate (derived)", hits / (hits + misses)))
+    for name in ("store_shard_rows", "store_shard_occupancy"):
+        series = gauges.get(name, {})
+        for key, v in sorted(series.items()):
+            rows.append((f"{name}{{{key}}}" if key else name, v))
+    if not rows:
+        return
+    print("== table capacity (cold tier + admission + occupancy) ==")
+    for label, v in rows:
+        print(f"  {label:54s} {v:g}")
+    print()
+
+
 def report_counters(snap: dict, top: int = 20) -> None:
     rows = []
     for name, series in snap.get("counters", {}).items():
@@ -210,6 +249,7 @@ def main() -> int:
         report_stages(snap)
         report_hists(snap)
         report_fleet(snap)
+        report_capacity(snap)
         report_gauges(snap)
         report_counters(snap, args.top)
     if args.trace:
