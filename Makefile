@@ -16,9 +16,9 @@ DATA_FORMAT ?= criteo
 DATA_OUT ?= $(basename $(DATA_IN)).rec
 
 .PHONY: test smoke ci lint lint-changed lint-baseline lockmap jitmap \
-	hlomap chaos fleet-chaos online-chaos obs-report convert \
-	stream-bench multichip-bench kernel-parity online-bench \
-	capacity-bench
+	hlomap chaos fleet-chaos online-chaos durability-chaos obs-report \
+	convert stream-bench multichip-bench kernel-parity online-bench \
+	capacity-bench durability-bench
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -97,6 +97,12 @@ fleet-chaos:
 online-chaos:
 	$(PY) -m pytest tests/ -m chaos -q -k online
 
+# durability suite alone (WAL append/replay faults, torn replicas, the
+# SIGKILL + disk-loss recovery ladder leg — docs/serving.md
+# "Durability & recovery")
+durability-chaos:
+	$(PY) -m pytest tests/ -m chaos -q -k "wal or replica or durab"
+
 # fused-kernel acceptance (ISSUE 13; docs/perf_notes.md "Fused FM
 # kernel"): byte-identical trajectories across fused_kernel={off, jnp,
 # pallas-if-available} at fs=1 and fs=4, on-device dedup parity vs the
@@ -114,7 +120,7 @@ smoke:
 	__graft_entry__.dryrun_multichip(8); \
 	print('entry + dryrun ok')"
 
-ci: lint test hlomap fleet-chaos smoke
+ci: lint test hlomap fleet-chaos durability-chaos smoke
 
 # human summary of a run's observability artifacts (docs/observability.md):
 #   make obs-report METRICS=run.metrics.jsonl TRACE=run.trace.json
@@ -149,3 +155,10 @@ online-bench:
 # baseline + cold-tier hit-rate across zipf skews
 capacity-bench:
 	$(PY) bench.py --capacity
+
+# durability cost/benefit (ISSUE 20; docs/serving.md "Durability &
+# recovery"): wal_overhead_pct (target <=5%), recovery_s for the
+# checkpoint+replay ladder climb, rpo_batches after a simulated
+# mid-window crash (bounded by wal_flush_batches)
+durability-bench:
+	$(PY) bench.py --durability

@@ -821,6 +821,105 @@ def run_online_bench(args) -> dict:
     }
 
 
+def run_durability_bench(args) -> dict:
+    """durability.* section (ISSUE 20): what the write-ahead delta log
+    costs and what it buys. Three numbers over one synthetic labeled
+    set: ``wal_overhead_pct`` — wall-clock cost of logging touched rows
+    every ``--durability-flush`` batches vs the identical WAL-off run
+    (target <= 5%); ``recovery_s`` — time for a FRESH learner to climb
+    the recovery ladder (checkpoint load + WAL replay) after the chain
+    loses its newest delta segment, the simulated mid-window crash; and
+    ``rpo_batches`` — batches of work that loss actually cost, which
+    the WAL bounds at one flush window (the RPO the knob buys, asserted
+    exactly in tests/test_durability.py's kill leg)."""
+    import os
+    import tempfile
+    import time
+
+    from difacto_tpu.__main__ import main as difacto_main
+    from difacto_tpu.durability import wal as _wal
+    from difacto_tpu.learners.sgd import SGDLearner
+
+    rng = np.random.RandomState(0)
+    flush = args.durability_flush
+    with tempfile.TemporaryDirectory() as td:
+        data = os.path.join(td, "train.libsvm")
+        with open(data, "w") as f:
+            for i in range(2000):
+                ids = np.sort(rng.choice(1 << 14, args.nnz_per_row,
+                                         replace=False))
+                f.write(f"{i % 2} "
+                        + " ".join(f"{j}:1" for j in ids) + "\n")
+        common = [f"data_in={data}", "lr=0.1", "batch_size=100",
+                  "max_num_epochs=2", "shuffle=0", "seed=7",
+                  "num_jobs_per_epoch=2", "report_interval=0",
+                  "hash_capacity=65536", "V_dim=8", "slot_dtype=fp32",
+                  # WAL forces device_cache_mb=0; pin it off in the
+                  # baseline too so overhead compares identical programs
+                  "device_cache_mb=0"]
+        # untimed warmup leg: the first run pays JIT compile for the
+        # fused step; timing it would swamp the <=5% WAL overhead target
+        difacto_main(common + [f"model_out={os.path.join(td, 'warm')}"])
+        t0 = time.perf_counter()
+        difacto_main(common + [f"model_out={os.path.join(td, 'base')}"])
+        base_s = time.perf_counter() - t0
+        model = os.path.join(td, "wal")
+        t0 = time.perf_counter()
+        difacto_main(common + [f"model_out={model}", "ckpt_interval=1",
+                               "auto_resume=1",
+                               f"wal_flush_batches={flush}"])
+        wal_s = time.perf_counter() - t0
+
+        # simulated mid-window crash inside the LAST epoch: the epoch's
+        # checkpoint and the final model never landed (deleted), and the
+        # newest delta window died with the process (newest real segment
+        # dropped) — the fresh learner must climb checkpoint(epoch-1) +
+        # WAL replay of the surviving verified prefix
+        import glob as _glob
+        import re as _re
+        epochs = sorted({int(m.group(1))
+                         for f in _glob.glob(model + "_iter-*")
+                         for m in [_re.search(r"_iter-(\d+)_", f)] if m})
+        for f in (_glob.glob(model + f"_iter-{epochs[-1]}_*")
+                  + _glob.glob(model + "_part-*")
+                  + _glob.glob(model + ".meta*")):
+            os.remove(f)
+        wdir = _wal.wal_dir(model)
+        gen = _wal.chain_generations(wdir, 0)[0]
+        chain = _wal.chain_segments(wdir, 0, gen)
+        head_full, dropped = 0, 0
+        for seq, seg in reversed(chain):
+            meta, _ = _wal.read_segment(seg)
+            head_full = max(head_full, int(meta["step_hi"]))
+            os.remove(seg)
+            dropped += 1
+            if meta["step_hi"] > meta["step_lo"]:
+                break
+        ln = SGDLearner()
+        ln.init([tuple(kv.split("=", 1)) for kv in common]
+                + [("model_out", model), ("ckpt_interval", "1"),
+                   ("auto_resume", "1"),
+                   ("wal_flush_batches", str(flush))])
+        t0 = time.perf_counter()
+        ln._try_resume()
+        recovery_s = time.perf_counter() - t0
+        ln.stop()
+        with open(model + ".recovery.json") as f:
+            stamp = json.load(f)
+        head_after = int(stamp["head"]["step"])
+    return {
+        "wal_overhead_pct": round(100.0 * (wal_s - base_s)
+                                  / max(base_s, 1e-9), 2),
+        "recovery_s": round(recovery_s, 3),
+        "rpo_batches": head_full - head_after,
+        "wal_flush_batches": flush,
+        "segments_dropped": dropped,
+        "recovery_rungs": stamp["rungs"],
+        "baseline_s": round(base_s, 3),
+        "wal_s": round(wal_s, 3),
+    }
+
+
 def run_multichip(args) -> dict:
     """multichip.* section: the capacity-scaling trajectory of the
     fs-sharded slot table (difacto_tpu/parallel/capacity.py) — for each
@@ -1041,6 +1140,15 @@ def main() -> None:
                            "table of --multichip-capacity * fs rows per "
                            "fs rung in {1,2,4,8}, ex/s + per-device "
                            "bytes per leg")
+    mode.add_argument("--durability", action="store_true",
+                      help="WAL overhead + recovery cost ONLY: WAL-off "
+                           "vs WAL-on wall clock, then a simulated "
+                           "mid-window crash recovered through the "
+                           "ladder (durability.{wal_overhead_pct, "
+                           "recovery_s, rpo_batches})")
+    ap.add_argument("--durability-flush", type=int, default=8,
+                    help="wal_flush_batches for the --durability legs "
+                         "(the RPO bound under test)")
     ap.add_argument("--delay-taus", default="0,1,4",
                     help="comma-separated bounded-delay windows for the "
                          "--multichip delay legs (τ batches of permitted "
@@ -1116,6 +1224,9 @@ def main() -> None:
         return
     if args.multichip:
         print(json.dumps({"multichip": run_multichip(args)}))
+        return
+    if args.durability:
+        print(json.dumps({"durability": run_durability_bench(args)}))
         return
 
     import jax

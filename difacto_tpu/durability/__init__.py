@@ -1,0 +1,28 @@
+"""Durable training state (ISSUE 20): the "kill anything, lose (almost)
+nothing" guarantee the reference parameter server gets from key-range
+replication, rebuilt for the reproduction's checkpoint-centric world.
+
+Three legs, composing with — never replacing — the verified-checkpoint
+machinery (utils/manifest.py, store/local.py save/load):
+
+- :mod:`.wal` — a write-ahead delta log: between full checkpoints each
+  rank appends the touched fused rows of the last ``wal_flush_batches``
+  steps as CRC'd segments; recovery = base generation + ordered deltas,
+  so the recovery point objective shrinks from ``ckpt_interval`` to
+  ``wal_flush_batches`` batches.
+- :mod:`.replicate` — async peer push of the shard family + live WAL
+  chain after each verified commit, with an anti-entropy scrub; a lost
+  local disk recovers by fetching the newest verifying peer copy.
+- :mod:`.recover` — the recovery ladder ``auto_resume`` climbs: local
+  generation walk-back -> peer fetch -> WAL replay to head, each
+  failure typed, each rung counted (``recovery_rung_total{rung}``).
+
+Knobs: ``wal_flush_batches`` / ``replica_peers`` / ``replica_k``
+(learners/sgd.py SGDLearnerParam; README knob table). All default OFF:
+the defaults-off build is byte-identical to the pre-durability code
+path. Runbook: docs/serving.md "Durability & recovery".
+"""
+
+from . import recover, replicate, wal  # noqa: F401
+from .replicate import Replicator, fetch_family  # noqa: F401
+from .wal import ReplayResult, WalCorrupt, WalWriter  # noqa: F401

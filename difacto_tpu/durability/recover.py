@@ -1,0 +1,167 @@
+"""Recovery ladder: local walk-back -> peer fetch -> WAL replay to head.
+
+``auto_resume``'s original rung — load the newest locally-verifying
+checkpoint generation, walking back over corrupt ones — bounded a
+crash's loss to one ``ckpt_interval``. With the durability subsystem on
+(``wal_flush_batches`` / ``replica_peers``), resume climbs a ladder:
+
+    rung "local" : the classic generation walk-back
+                   (learners/sgd.py _try_resume_base)
+    rung "peer"  : nothing local verifies (disk loss, fresh host) ->
+                   fetch the newest verifying peer replica of the whole
+                   family + its WAL chain (replicate.fetch_family), then
+                   re-run the local walk-back over the fetched files
+    rung "wal"   : replay the delta chain rooted at the loaded base
+                   generation to its verified head
+                   (wal.replay — torn/gap/geometry stops are typed and
+                   land on a consistent earlier batch boundary)
+
+Every failure on the way is TYPED (CheckpointCorrupt / WalCorrupt /
+FaultInjected / OSError) and demotes to the next rung; every rung that
+contributes is counted in ``recovery_rung_total{rung}`` and recorded in
+the ``<model_out>.recovery.json`` stamp, so a post-incident read shows
+exactly how the process came back and how much work replay recovered
+(``wal_replay_batches``). ``launch.py`` relaunch and the bounded-delay
+restart attempt (parallel/fault.py) compose unchanged: they re-exec the
+process, and this ladder is simply what its ``auto_resume`` now does.
+
+The resume contract with the epoch loop stays the reference's: the
+ladder returns the last COMPLETED epoch (run() restarts at the next
+one) and arms ``learner._wal_skip`` when the WAL head sits mid-epoch —
+the re-entered epoch skips the batches whose effects the replay already
+applied, so the continued trajectory is byte-identical to an unkilled
+run at the same batch boundary (the deterministic data order makes the
+skipped prefix exactly the replayed prefix).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+from ..obs import counter
+from . import replicate, wal as _wal
+
+log = logging.getLogger("difacto_tpu")
+
+
+def _rung_counter():
+    return counter(
+        "recovery_rung_total",
+        "recovery-ladder rungs that contributed to a resume, per rung "
+        "(fresh = nothing recoverable, started from scratch)")
+
+
+def run_ladder(learner) -> Optional[int]:
+    """Climb the ladder for ``learner`` (an SGDLearner with the
+    durability knobs resolved). Mutates the store to the recovered
+    state, re-roots the learner's WalWriter, sets ``learner._wal_skip``
+    and writes the recovery stamp. Returns the last completed epoch
+    (−1 = WAL-only progress on a virgin base), or None to start
+    fresh."""
+    p = learner.param
+    rungs = []
+    rung_c = _rung_counter()
+
+    got = learner._try_resume_base()
+    if got is not None:
+        rungs.append("local")
+        rung_c.labels(rung="local").inc()
+
+    peers = replicate.parse_peers(p.replica_peers)
+    if got is None and peers:
+        peer = replicate.fetch_family(p.model_out, peers)
+        if peer is not None:
+            got = learner._try_resume_base()
+            if got is not None:
+                rungs.append("peer")
+                rung_c.labels(rung="peer").inc()
+            else:
+                log.warning("recovery: peer %s family fetched but no "
+                            "generation verified locally", peer)
+
+    if learner._wal is None:
+        if got is None:
+            rung_c.labels(rung="fresh").inc()
+            _write_stamp(p.model_out, rungs, None, None)
+            return None
+        _write_stamp(p.model_out, rungs, got[0], None)
+        return got[0]
+
+    return _replay_rung(learner, got, rungs, rung_c)
+
+
+def _replay_rung(learner, got, rungs, rung_c) -> Optional[int]:
+    from ..utils import manifest as mft
+    p = learner.param
+    writer: _wal.WalWriter = learner._wal
+    if got is not None:
+        base_epoch, path = got
+        man = mft.read(path) or {}
+        generation = int(man.get("generation", 0))
+    else:
+        # virgin base: init_state(seed) is deterministic
+        # (updaters/sgd_updater.py), so a chain rooted at generation 0
+        # replays onto the freshly initialized table with no checkpoint
+        # at all — mid-epoch-0 crashes still recover to the WAL head
+        base_epoch, generation = -1, 0
+        if not _wal.chain_segments(_wal.wal_dir(p.model_out),
+                                   learner._host_rank, 0):
+            rung_c.labels(rung="fresh").inc()
+            _write_stamp(p.model_out, rungs, None, None)
+            return None
+
+    res = _wal.replay(learner.store, _wal.wal_dir(p.model_out),
+                      learner._host_rank, generation,
+                      base_epoch=base_epoch)
+    writer.adopt(generation, res.next_seq, base_epoch)
+    if res.segments:
+        rungs.append("wal")
+        rung_c.labels(rung="wal").inc()
+        log.info("recovery: WAL replayed %d batches (%d segments) to "
+                 "(epoch %d, step %d%s) on generation %d",
+                 res.batches, res.segments, res.epoch, res.step,
+                 ", boundary" if res.boundary else "", generation)
+
+    if res.epoch < 0 or (res.epoch == base_epoch and res.segments == 0):
+        # no delta progress past the base checkpoint
+        resumed, skip = (None if base_epoch < 0 else base_epoch), 0
+    elif res.boundary:
+        # the head closes its epoch: it IS a completed epoch
+        resumed, skip = res.epoch, 0
+    else:
+        # mid-epoch head: re-enter epoch res.epoch and skip the batches
+        # replay already applied
+        resumed = res.epoch - 1 if res.epoch > 0 else -1
+        skip = res.step
+        if res.epoch == 0:
+            resumed = -1
+    learner._wal_skip = skip
+    if not rungs and resumed is None:
+        rung_c.labels(rung="fresh").inc()
+    _write_stamp(p.model_out, rungs, resumed, res, skip)
+    return resumed
+
+
+def _write_stamp(model_out: str, rungs, resumed, res,
+                 skip: int = 0) -> None:
+    """``<model_out>.recovery.json``: how the last resume came back —
+    the post-incident audit record (docs/serving.md runbook)."""
+    doc = {"rungs": rungs, "resumed_epoch": resumed}
+    if res is not None:
+        doc.update(base_generation=res.generation,
+                   wal_replay_batches=res.batches,
+                   wal_segments=res.segments,
+                   head={"epoch": res.epoch, "step": res.step,
+                         "boundary": res.boundary},
+                   stopped=res.stopped, skip_batches=skip)
+    tmp = model_out + ".recovery.json.tmp"
+    try:
+        os.makedirs(os.path.dirname(model_out) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+        os.replace(tmp, model_out + ".recovery.json")
+    except OSError as e:  # pragma: no cover - stamp is best-effort
+        log.warning("recovery stamp write failed: %s", e)

@@ -1,0 +1,341 @@
+"""Peer replication of checkpoint families and their live WAL chains.
+
+The reference parameter server survives a dead server because the
+key-range it owned is *replicated on peer machines* — recovery is a
+fetch, not a recompute (PAPER.md PS architecture). This module is that
+leg for the reproduction: after each verified commit, a rank's shard
+family files (``<model>_iter-k_part-r`` / ``_fs-i-of-n`` + manifests +
+the ``.meta`` progress stub) and its live WAL segments are pushed
+asynchronously to ``replica_k`` of the ``replica_peers`` destinations —
+OFF the step path, so training throughput never waits on replication.
+
+Peers are directories: a shared filesystem path, or a per-peer mount of
+another host's disk (remote URI transports are out of scope — the
+stream layer's file:// is the only transport the container guarantees).
+The push preserves the path's shape relative to the model's directory
+(``model_iter-0_part-0.npz`` and ``model.wal/r000-...dfwal`` land under
+the same names at the peer), so :func:`fetch_family` can restore a lost
+local dir byte-for-byte and the recovery ladder (durability/recover.py)
+resumes from it exactly as from a local checkpoint.
+
+Every copy is tmp + atomic rename with a sha256 readback compare, so a
+peer never exposes a torn file under its final name. The anti-entropy
+:meth:`Replicator.scrub` re-verifies what the peer actually holds —
+npz members against their manifests (utils/manifest.py), WAL segments
+through their CRCs (durability/wal.py), byte-compare for sidecars —
+and re-pushes anything missing or corrupt, counted in
+``replica_scrub_repairs_total``. Staleness is observable as the
+``replica_lag_generations{peer}`` gauge: committed generations the peer
+has not finished receiving (0 = caught up).
+
+Chaos: pushes traverse the ``replica.push`` injection point and fetches
+``replica.fetch`` (utils/faultinject.py catalog for per-kind semantics).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import shutil
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..obs import counter, gauge
+from ..utils import faultinject
+from ..utils.locktrace import condition
+from . import wal as _wal
+
+log = logging.getLogger("difacto_tpu")
+
+
+def parse_peers(spec: str) -> List[str]:
+    """``replica_peers`` knob -> peer directory list (comma-separated,
+    blanks dropped)."""
+    return [p.strip() for p in spec.split(",") if p.strip()]
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def push_file(src: str, peer: str, root: str) -> str:
+    """Copy one file to ``peer`` preserving its path relative to
+    ``root``, tmp + rename + sha256 readback. Traverses the
+    ``replica.push`` fault point: ``err`` is a failed copy (the caller
+    counts it and moves on — the scrub repairs later), ``truncate``
+    lands a half-length file at the final name, exactly the torn
+    artifact the scrub's verification must catch."""
+    kind = faultinject.fire("replica.push")
+    if kind is not None and kind != "truncate":
+        faultinject.act_default(kind)
+    rel = os.path.relpath(src, root)
+    dst = os.path.join(peer, rel)
+    os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+    tmp = dst + ".tmp"
+    if kind == "truncate":
+        with open(src, "rb") as f:
+            buf = f.read()
+        with open(tmp, "wb") as f:
+            f.write(buf[:max(len(buf) // 2, 1)])
+        os.replace(tmp, dst)
+        return dst
+    want = _sha256(src)
+    shutil.copyfile(src, tmp)
+    if _sha256(tmp) != want:  # pragma: no cover - needs a racing writer
+        os.remove(tmp)
+        raise OSError(f"replica copy of {src} to {peer} failed readback")
+    os.replace(tmp, dst)
+    return dst
+
+
+def fetch_file(peer: str, rel: str, root: str) -> str:
+    """Copy ``peer``'s copy of ``rel`` back into the local ``root``
+    (tmp + rename; content verification is the caller's job — the
+    recovery ladder runs the fetched family through the same manifest /
+    CRC gates a local checkpoint passes). Traverses ``replica.fetch``:
+    ``err`` is a dead peer / failed read and must surface typed so the
+    ladder tries the next peer."""
+    kind = faultinject.fire("replica.fetch")
+    if kind is not None:
+        faultinject.act_default(kind)
+    src = os.path.join(peer, rel)
+    dst = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+    tmp = dst + ".tmp"
+    shutil.copyfile(src, tmp)
+    os.replace(tmp, dst)
+    return dst
+
+
+def family_files(model_out: str) -> List[str]:
+    """Every local file of ``model_out``'s durable state: checkpoint
+    family members + manifests + the ``.meta`` progress stub + live WAL
+    segments. This is the replication unit AND the fetch unit."""
+    import glob as _glob
+    out = sorted(_glob.glob(model_out + "_iter-*")) \
+        + sorted(_glob.glob(model_out + ".meta")) \
+        + sorted(_glob.glob(model_out + ".recovery.json"))
+    d = _wal.wal_dir(model_out)
+    if os.path.isdir(d):
+        out += sorted(os.path.join(d, n) for n in os.listdir(d)
+                      if n.endswith(_wal.SUFFIX))
+    return out
+
+
+def fetch_family(model_out: str, peers: Sequence[str]) -> Optional[str]:
+    """Restore ``model_out``'s family from the first peer holding one
+    (newest-generation peer wins when several do). Returns the peer
+    used, or None. Typed per-file failures (FaultInjected/OSError) fail
+    that peer and move to the next — a half-fetched family is then
+    overwritten by the next peer or rejected by the ladder's verify."""
+    from ..utils import manifest as mft
+    root = os.path.dirname(model_out) or "."
+    base = os.path.basename(model_out)
+    ranked: List[Tuple[int, str]] = []
+    for peer in peers:
+        try:
+            names = os.listdir(peer)
+        except OSError:
+            continue
+        gen = -1
+        for n in names:
+            if n.startswith(base + "_iter-") \
+                    and n.endswith(mft.MANIFEST_SUFFIX):
+                man = mft.read(os.path.join(peer, n[:-len(
+                    mft.MANIFEST_SUFFIX)]))
+                if man:
+                    gen = max(gen, int(man.get("generation", 0)))
+        if gen >= 0:
+            ranked.append((gen, peer))
+    ranked.sort(reverse=True)
+    fetch_fail = counter(
+        "replica_fetch_failures_total",
+        "files a recovery fetch failed to pull from a peer")
+    for _, peer in ranked:
+        rels = [n for n in os.listdir(peer)
+                if n.startswith(base + "_iter-") or n == base + ".meta"]
+        wdir = os.path.join(peer, base + ".wal")
+        if os.path.isdir(wdir):
+            rels += [os.path.join(base + ".wal", n)
+                     for n in os.listdir(wdir)
+                     if n.endswith(_wal.SUFFIX)]
+        ok = True
+        for rel in sorted(rels):
+            try:
+                fetch_file(peer, rel, root)
+            except (faultinject.FaultInjected, OSError) as e:
+                fetch_fail.inc()
+                log.warning("replica fetch of %s from %s failed: %s; "
+                            "trying the next peer", rel, peer, e)
+                ok = False
+                break
+        if ok and rels:
+            log.info("recovered %d family files for %s from peer %s",
+                     len(rels), model_out, peer)
+            return peer
+    return None
+
+
+class Replicator:
+    """Async push worker: the learner enqueues (files, generation,
+    epoch) after each verified commit / WAL append; one daemon thread
+    drains the queue and copies to ``k`` peers, never holding the lock
+    across IO. ``close()`` drains and joins."""
+
+    def __init__(self, peers: Sequence[str], k: int, rank: int,
+                 root: str):
+        self.peers = list(peers)
+        self.k = max(1, min(int(k), len(self.peers)) if self.peers
+                     else int(k))
+        self.rank = rank
+        self.root = root or "."
+        self._cv = condition()
+        self._queue: List[Tuple[List[str], int, Optional[int]]] = []
+        self._inflight_epochs: Set[int] = set()
+        self._closed = False
+        self._enqueued_gen = 0
+        self._pushed_gen: Dict[str, int] = {p: 0 for p in self.peers}
+        self._push_fail = counter(
+            "replica_push_failures_total",
+            "files an async replica push failed to land on a peer")
+        self._lag = gauge(
+            "replica_lag_generations",
+            "committed generations a replica peer has not finished "
+            "receiving (0 = caught up)")
+        self._thread = threading.Thread(
+            target=self._run, name=f"replica-push-r{rank}", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ API
+    def push(self, files: Iterable[str], generation: int = 0,
+             epoch: Optional[int] = None) -> None:
+        """Enqueue a file set for replication (returns immediately —
+        the copy happens on the worker thread, off the step path)."""
+        files = [f for f in files if os.path.exists(f)]
+        if not files or not self.peers:
+            return
+        with self._cv:
+            self._queue.append((files, generation, epoch))
+            if epoch is not None:
+                self._inflight_epochs.add(epoch)
+            self._enqueued_gen = max(self._enqueued_gen, generation)
+            self._update_lag_locked()
+            self._cv.notify()
+
+    def protected_epochs(self) -> Set[int]:
+        """Epochs with queued or in-flight pushes — ``ckpt_keep``
+        pruning must not retire these while a peer is still receiving
+        them (utils/manifest.py prune_checkpoints ``protect``)."""
+        with self._cv:
+            return set(self._inflight_epochs)
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Block until the queue drains (True) or ``timeout`` elapses.
+        Commit boundaries call this only where durability beats latency
+        (final save, shutdown)."""
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: not self._queue and not self._inflight_epochs,
+                timeout=timeout)
+
+    def close(self, timeout: float = 30.0) -> None:
+        self.flush(timeout)
+        with self._cv:
+            self._closed = True
+            self._cv.notify()
+        self._thread.join(timeout=timeout)
+
+    def scrub(self, model_out: str) -> int:
+        """Anti-entropy pass: verify every family file at every peer —
+        npz members against their manifest digests, WAL segments
+        through their CRCs, byte-compare for sidecars — and re-push
+        anything missing or failing. Returns the repair count (also in
+        ``replica_scrub_repairs_total``)."""
+        from ..utils import manifest as mft
+        repairs = 0
+        repair_c = counter(
+            "replica_scrub_repairs_total",
+            "peer replica files re-pushed by the anti-entropy scrub")
+        for src in family_files(model_out):
+            rel = os.path.relpath(src, self.root)
+            for peer in self.peers[:self.k]:
+                dst = os.path.join(peer, rel)
+                if self._peer_copy_ok(src, dst, mft):
+                    continue
+                try:
+                    push_file(src, peer, self.root)
+                    repairs += 1
+                    repair_c.inc()
+                except (faultinject.FaultInjected, OSError) as e:
+                    self._push_fail.inc()
+                    log.warning("scrub re-push of %s to %s failed: %s",
+                                rel, peer, e)
+        return repairs
+
+    # -------------------------------------------------------- worker
+    def _peer_copy_ok(self, src: str, dst: str, mft) -> bool:
+        if not os.path.exists(dst):
+            return False
+        # a checkpoint member (it has a manifest sidecar locally) gets
+        # the real digest verification — the same gate a loader applies
+        if not src.endswith(mft.MANIFEST_SUFFIX) \
+                and os.path.exists(src + mft.MANIFEST_SUFFIX):
+            try:
+                mft.verify(dst, require_manifest=True)
+                return True
+            except (mft.CheckpointCorrupt, OSError):
+                return False
+        if dst.endswith(_wal.SUFFIX):
+            try:
+                _wal.read_segment(dst)
+                return True
+            except (_wal.WalCorrupt, OSError):
+                return False
+        try:
+            with open(src, "rb") as a, open(dst, "rb") as b:
+                return a.read() == b.read()
+        except OSError:
+            return False
+
+    def _update_lag_locked(self) -> None:
+        for p in self.peers[:self.k]:
+            self._lag.labels(peer=os.path.basename(p.rstrip("/")) or p
+                             ).set(max(0, self._enqueued_gen
+                                       - self._pushed_gen.get(p, 0)))
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if not self._queue and self._closed:
+                    return
+                files, generation, epoch = self._queue.pop(0)
+            # copy OUTSIDE the lock: replication IO must never block
+            # the enqueueing (training) thread
+            for peer in self.peers[:self.k]:
+                ok = True
+                for src in files:
+                    try:
+                        push_file(src, peer, self.root)
+                    except (faultinject.FaultInjected, OSError) as e:
+                        ok = False
+                        self._push_fail.inc()
+                        log.warning(
+                            "replica push of %s to %s failed: %s (the "
+                            "anti-entropy scrub repairs it)", src, peer,
+                            e)
+                if ok and generation:
+                    self._pushed_gen[peer] = max(
+                        self._pushed_gen.get(peer, 0), generation)
+            with self._cv:
+                if epoch is not None and not any(
+                        e == epoch for _, _, e in self._queue):
+                    self._inflight_epochs.discard(epoch)
+                self._update_lag_locked()
+                self._cv.notify_all()

@@ -1,0 +1,452 @@
+"""Write-ahead delta log: CRC'd touched-row segments between checkpoints.
+
+Full verified checkpoints (store/local.py save + utils/manifest.py) bound
+a crash's data loss to one ``ckpt_interval`` of work — every batch since
+the last generation replays after a SIGKILL. The reference parameter
+server does better by construction: server state is replicated across
+machines as it mutates, so a dead host loses (almost) nothing (PAPER.md
+scheduler/server/worker roles). This module is the single-host half of
+that story: between full checkpoints, the trainer appends the *touched
+fused rows* of the last ``wal_flush_batches`` dispatched steps as one
+CRC'd segment, so recovery = base generation + ordered deltas and the
+recovery point objective drops from ``ckpt_interval`` epochs to
+``wal_flush_batches`` batches.
+
+Segment format (little-endian, rec2's framing idiom — data/rec2.py):
+
+    [0]   magic  b"DFWAL1\\0\\0"                     8 bytes
+    [8]   u32 version (=1) | u32 n_sections
+    [16]  u32 table_crc32 (over the section table) | u32 pad
+    [24]  n_sections x section entry (48 bytes each):
+              name   16 bytes (ascii, NUL padded)
+              dtype  16 bytes (numpy/ml_dtypes dtype NAME, NUL padded)
+              u64    byte offset (64-aligned, from file start)
+              u64    nbytes
+    [..]  u32 crc32 per section
+    [..]  sections, each aligned to 64
+
+Section ``meta`` is a JSON document (uint8 bytes) carrying the chain
+position (generation / seq / rank), the covered step window (epoch,
+step_lo, step_hi, boundary) and the table geometry stamp (hash_capacity,
+V_dim, slot_dtype, row width) that replay validates before applying.
+Section ``slots`` is the sorted unique i32 row ids the window touched;
+the remaining sections are the row payload exactly as the device stores
+it — ``VVg`` CONTAINER rows for the fused layout (so int8/fp8/bf16
+``slot_dtype`` tables log container bytes, not dequantized f32: the log
+is quantization-aware and replay is bit-exact by construction), or the
+five flat f32/bool columns when ``V_dim == 0``.
+
+Integrity mirrors rec2: header CRC over the section table, one CRC per
+section, tmp + atomic rename so a torn write is never observable at the
+final name. :func:`read_segment` raises a typed :class:`WalCorrupt` on
+truncation, bit flips or a bad magic — :func:`replay` treats a corrupt
+or missing segment as the end of the verified prefix (torn-tail
+tolerant, like online/log.py's sealed segments) and NEVER applies bytes
+past it, so recovery lands on a consistent earlier batch boundary
+instead of a silently-wrong state.
+
+Chaos: appends traverse the ``wal.append`` injection point, replays
+``wal.replay`` (utils/faultinject.py — the catalog there documents the
+per-kind semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("difacto_tpu")
+
+MAGIC = b"DFWAL1\0\0"
+VERSION = 1
+SUFFIX = ".dfwal"
+ALIGN = 64
+
+_HEAD = struct.Struct("<8sIIII")     # magic, version, n_sections, crc, pad
+_SECT = struct.Struct("<16s16sQQ")   # name, dtype name, offset, nbytes
+_SEG_RE = re.compile(r"r(\d+)-g(\d+)-s(\d+)\.dfwal$")
+
+# the only sections a segment may carry: the chain meta, the touched
+# slot ids, and the row payload of either state layout (fused VVg
+# container rows, or the five flat columns of the V_dim=0 layout)
+SECTION_NAMES = ("meta", "slots", "VVg", "w", "z", "sqrt_g", "cnt",
+                 "v_live")
+
+
+class WalCorrupt(ValueError):
+    """A WAL segment failed structural or checksum validation (torn
+    write, truncation, bit flip) or disagrees with the chain it claims
+    to extend. Typed so replay stops at the verified prefix — the delta
+    log's analog of store.local.CheckpointCorrupt."""
+
+
+def wal_dir(model_out: str) -> str:
+    """The delta-log directory of a model family: ``<model_out>.wal``."""
+    return model_out + ".wal"
+
+
+def segment_name(rank: int, generation: int, seq: int) -> str:
+    return f"r{rank:03d}-g{generation:06d}-s{seq:06d}{SUFFIX}"
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Dtype by NAME, including the ml_dtypes containers numpy itself
+    cannot parse (bfloat16, float8_e4m3fn, ...) — jax always ships
+    ml_dtypes, so quantized WAL rows round-trip without new deps."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except AttributeError as e:
+            raise WalCorrupt(f"unknown WAL section dtype {name!r}") from e
+
+
+def _align(n: int) -> int:
+    return (n + ALIGN - 1) // ALIGN * ALIGN
+
+
+def _corrupt(path: str, why: str) -> WalCorrupt:
+    return WalCorrupt(f"corrupt WAL segment {path!r}: {why}")
+
+
+def _encode(meta: dict, arrays: Dict[str, np.ndarray]) -> bytes:
+    sects = {"meta": np.frombuffer(
+        json.dumps(meta, sort_keys=True).encode(), dtype=np.uint8)}
+    sects.update(arrays)
+    names = list(sects)
+    for n in names:
+        if n not in SECTION_NAMES:
+            raise ValueError(f"unknown WAL section {n!r} "
+                             f"(one of {SECTION_NAMES})")
+    header_len = _HEAD.size + len(names) * _SECT.size + len(names) * 4
+    off = _align(header_len)
+    entries, crcs, mats = [], [], []
+    for n in names:
+        a = np.ascontiguousarray(sects[n])
+        # tobytes, not a.data: ml_dtypes containers (bfloat16, fp8)
+        # have no buffer-protocol format char
+        raw = a.tobytes()
+        mats.append(raw)
+        entries.append((n.encode().ljust(16, b"\0"),
+                        a.dtype.name.encode().ljust(16, b"\0"),
+                        off, len(raw)))
+        crcs.append(zlib.crc32(raw))
+        off = _align(off + len(raw))
+    table = b"".join(_SECT.pack(*e) for e in entries) \
+        + b"".join(struct.pack("<I", c) for c in crcs)
+    out = bytearray(_HEAD.pack(MAGIC, VERSION, len(names),
+                               zlib.crc32(table), 0))
+    out += table
+    for (_, _, o, _), raw in zip(entries, mats):
+        out += b"\0" * (o - len(out))
+        out += raw
+    return bytes(out)
+
+
+def write_segment(path: str, meta: dict,
+                  arrays: Dict[str, np.ndarray]) -> int:
+    """Atomically write one delta segment (tmp + rename); returns the
+    byte size. Traverses the ``wal.append`` fault point: ``err`` raises
+    (the caller retains its window and retries at the next flush
+    boundary), ``truncate`` tears the segment at its final name — the
+    torn-tail shape replay's CRCs must reject — ``kill`` dies before
+    any bytes land (the honest crash mid-window)."""
+    from ..utils import faultinject
+    kind = faultinject.fire("wal.append")
+    if kind is not None and kind != "truncate":
+        faultinject.act_default(kind)
+    buf = _encode(meta, arrays)
+    if kind == "truncate":
+        buf = buf[:max(len(buf) // 2, 1)]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf)
+    os.replace(tmp, path)
+    return len(buf)
+
+
+def read_segment(path: str, verify: bool = True) -> Tuple[dict, dict]:
+    """Read one segment -> (meta, {name: array}). Raises the typed
+    :class:`WalCorrupt` on any structural or checksum failure — never a
+    crash or a silent short read. Traverses ``wal.replay``: ``err`` is
+    a failed disk read, ``truncate`` reads a half-length view which the
+    CRCs reject."""
+    from ..utils import faultinject
+    kind = faultinject.fire("wal.replay")
+    if kind == "err":  # pragma: no cover - fire() raises for err itself
+        raise _corrupt(path, "injected read error")
+    try:
+        with open(path, "rb") as f:
+            buf = f.read()
+    except OSError as e:
+        if isinstance(e, FileNotFoundError):
+            raise
+        raise _corrupt(path, f"unreadable ({e})") from e
+    if kind == "truncate":
+        buf = buf[:max(len(buf) // 2, 1)]
+    elif kind is not None:
+        faultinject.act_default(kind)
+    if len(buf) < _HEAD.size:
+        raise _corrupt(path, f"file too short ({len(buf)} bytes)")
+    magic, version, n_sections, head_crc, _ = _HEAD.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise _corrupt(path, f"bad magic {magic!r}")
+    if version != VERSION:
+        raise _corrupt(path, f"unsupported version {version}")
+    if not 0 < n_sections <= len(SECTION_NAMES):
+        raise _corrupt(path, f"implausible section count {n_sections}")
+    table_len = n_sections * _SECT.size + n_sections * 4
+    if len(buf) < _HEAD.size + table_len:
+        raise _corrupt(path, "truncated section table")
+    table = buf[_HEAD.size:_HEAD.size + table_len]
+    if zlib.crc32(table) != head_crc:
+        raise _corrupt(path, "section table checksum mismatch")
+    crc_base = _HEAD.size + n_sections * _SECT.size
+    arrays: Dict[str, np.ndarray] = {}
+    for i in range(n_sections):
+        name_b, dtype_b, off, nbytes = _SECT.unpack_from(
+            buf, _HEAD.size + i * _SECT.size)
+        name = name_b.rstrip(b"\0").decode("ascii", "replace")
+        if name not in SECTION_NAMES:
+            raise _corrupt(path, f"unknown section {name!r}")
+        dt = _resolve_dtype(dtype_b.rstrip(b"\0").decode("ascii",
+                                                         "replace"))
+        if off % ALIGN or off + nbytes > len(buf):
+            raise _corrupt(
+                path, f"section {name!r} [{off}, {off + nbytes}) outside "
+                f"file of {len(buf)} bytes")
+        if dt.itemsize == 0 or nbytes % dt.itemsize:
+            raise _corrupt(path, f"section {name!r} nbytes {nbytes} not "
+                           f"a multiple of dtype {dt.name}")
+        view = buf[off:off + nbytes]
+        if verify:
+            want, = struct.unpack_from("<I", buf, crc_base + 4 * i)
+            if zlib.crc32(view) != want:
+                raise _corrupt(path, f"section {name!r} checksum "
+                               "mismatch")
+        arrays[name] = np.frombuffer(view, dtype=dt)
+    if "meta" not in arrays or "slots" not in arrays:
+        raise _corrupt(path, "meta/slots section missing")
+    try:
+        meta = json.loads(bytes(arrays.pop("meta")).decode())
+    except ValueError as e:
+        raise _corrupt(path, f"unreadable meta ({e})") from e
+    return meta, arrays
+
+
+def chain_segments(dir_: str, rank: int,
+                   generation: int) -> List[Tuple[int, str]]:
+    """[(seq, path)] of the chain rooted at ``generation``, seq order.
+    A seq gap is NOT resolved here — replay stops at it typed."""
+    out = []
+    try:
+        names = os.listdir(dir_)
+    except FileNotFoundError:
+        return []
+    for name in names:
+        m = _SEG_RE.match(name)
+        if m and int(m.group(1)) == rank and int(m.group(2)) == generation:
+            out.append((int(m.group(3)), os.path.join(dir_, name)))
+    out.sort()
+    return out
+
+
+def chain_generations(dir_: str, rank: int) -> List[int]:
+    """Generations with at least one segment for ``rank``, descending."""
+    gens = set()
+    try:
+        names = os.listdir(dir_)
+    except FileNotFoundError:
+        return []
+    for name in names:
+        m = _SEG_RE.match(name)
+        if m and int(m.group(1)) == rank:
+            gens.add(int(m.group(2)))
+    return sorted(gens, reverse=True)
+
+
+@dataclass
+class ReplayResult:
+    """What :func:`replay` applied: the verified contiguous prefix of
+    the chain. ``epoch``/``step`` are the batch boundary the recovered
+    state now sits at; ``boundary`` marks an epoch-complete head."""
+    generation: int
+    epoch: int = -1
+    step: int = 0
+    boundary: bool = True
+    batches: int = 0
+    segments: int = 0
+    next_seq: int = 0
+    stopped: str = ""  # "" = clean head; else torn|gap|geometry|chain
+
+
+def _geom_ok(meta: dict, geom: dict) -> bool:
+    return all(meta.get(k) == v for k, v in geom.items())
+
+
+def replay(store, dir_: str, rank: int, generation: int,
+           base_epoch: int = -1) -> ReplayResult:
+    """Apply the chain rooted at ``generation`` onto ``store`` (already
+    holding the base state), in seq order, stopping TYPED at the first
+    gap, corruption or geometry mismatch — everything before the stop is
+    a consistent batch boundary; nothing after it is applied. Counted
+    into ``wal_replay_batches`` / ``wal_replay_dropped_total``."""
+    from ..obs import counter
+    geom = store.wal_geometry()
+    res = ReplayResult(generation=generation, epoch=base_epoch)
+    segs = chain_segments(dir_, rank, generation)
+    want_seq = 0
+    for seq, path in segs:
+        if seq != want_seq:
+            log.warning("wal replay: seq gap at %s (want seq %d); "
+                        "stopping at the verified prefix", path, want_seq)
+            counter("wal_replay_dropped_total",
+                    "WAL segments dropped at replay, by reason"
+                    ).labels(reason="gap").inc(len(segs) - res.segments)
+            res.stopped = "gap"
+            return res
+        try:
+            meta, arrays = read_segment(path)
+        except (WalCorrupt, OSError) as e:
+            log.warning("wal replay: %s; stopping at the verified "
+                        "prefix", e)
+            counter("wal_replay_dropped_total",
+                    "WAL segments dropped at replay, by reason"
+                    ).labels(reason="torn").inc(len(segs) - res.segments)
+            res.stopped = "torn"
+            return res
+        if not _geom_ok(meta, geom) or meta.get("generation") != generation \
+                or meta.get("rank") != rank or meta.get("seq") != seq:
+            log.warning("wal replay: %s geometry/chain stamp disagrees "
+                        "with the live table; stopping", path)
+            counter("wal_replay_dropped_total",
+                    "WAL segments dropped at replay, by reason"
+                    ).labels(reason="geometry").inc(
+                        len(segs) - res.segments)
+            res.stopped = "geometry"
+            return res
+        epoch, lo, hi = (int(meta["epoch"]), int(meta["step_lo"]),
+                         int(meta["step_hi"]))
+        contiguous = (
+            (epoch == res.epoch and lo == res.step)
+            or (res.boundary and epoch == res.epoch + 1 and lo == 0))
+        if not contiguous:
+            log.warning("wal replay: %s covers (%d, %d..%d) but the "
+                        "head is (%d, %d); stopping", path, epoch, lo,
+                        hi, res.epoch, res.step)
+            counter("wal_replay_dropped_total",
+                    "WAL segments dropped at replay, by reason"
+                    ).labels(reason="chain").inc(len(segs) - res.segments)
+            res.stopped = "chain"
+            return res
+        slots = arrays.pop("slots").astype(np.int32)
+        store.apply_wal_rows(slots, arrays)
+        res.epoch, res.step = epoch, hi
+        res.boundary = bool(meta.get("boundary"))
+        res.batches += hi - lo
+        res.segments += 1
+        res.next_seq = seq + 1
+        want_seq = seq + 1
+    if res.batches:
+        counter("wal_replay_batches",
+                "training batches recovered from WAL deltas instead of "
+                "re-executed").inc(res.batches)
+    return res
+
+
+@dataclass
+class WalWriter:
+    """Per-rank append head of the delta log. The learner owns the
+    flush cadence; this class owns naming, chain position and retention.
+    Single-threaded by contract: every call rides the dispatch thread
+    (appends) or startup (rebase/adopt), never concurrently."""
+    dir: str
+    rank: int
+    geom: dict
+    generation: int = 0
+    seq: int = 0
+    # epoch of the checkpoint the live chain is rooted at; None until
+    # the first rebase — prune protection (utils/manifest.py
+    # prune_checkpoints) reads this so a live chain's base generation
+    # is never retired under it
+    base_epoch: Optional[int] = None
+    keep_generations: int = 2
+    _bytes_c: object = field(default=None, repr=False)
+
+    def append(self, slots: np.ndarray, arrays: Dict[str, np.ndarray],
+               epoch: int, step_lo: int, step_hi: int,
+               boundary: bool = False) -> Optional[str]:
+        """Write one segment covering steps [step_lo, step_hi) of
+        ``epoch``; returns its path (None for an empty non-boundary
+        window). Raises FaultInjected/OSError on a failed write — the
+        caller retains the window and retries at the next boundary."""
+        if len(slots) == 0 and not boundary:
+            return None
+        meta = dict(self.geom)
+        meta.update(generation=self.generation, seq=self.seq,
+                    rank=self.rank, epoch=int(epoch),
+                    step_lo=int(step_lo), step_hi=int(step_hi),
+                    boundary=bool(boundary))
+        path = os.path.join(
+            self.dir, segment_name(self.rank, self.generation, self.seq))
+        sects = {"slots": np.asarray(slots, dtype=np.int32)}
+        sects.update(arrays)
+        nbytes = write_segment(path, meta, sects)
+        self.seq += 1
+        if self._bytes_c is None:
+            from ..obs import counter
+            self._bytes_c = counter(
+                "wal_bytes_total",
+                "bytes appended to the write-ahead delta log")
+        self._bytes_c.inc(nbytes)
+        return path
+
+    def rebase(self, generation: int, epoch: Optional[int]) -> None:
+        """Root the chain at a freshly committed checkpoint generation
+        and retire chains older than ``keep_generations`` bases back
+        (the newest checkpoint supersedes their deltas; one extra base
+        is kept so a corrupt newest generation still walks back to a
+        base+chain pair)."""
+        self.generation = generation
+        self.seq = 0
+        self.base_epoch = epoch
+        keep = generation - (self.keep_generations - 1)
+        try:
+            names = os.listdir(self.dir)
+        except FileNotFoundError:
+            return
+        for name in names:
+            m = _SEG_RE.match(name)
+            if m and int(m.group(1)) == self.rank \
+                    and int(m.group(2)) < keep:
+                try:
+                    os.remove(os.path.join(self.dir, name))
+                except OSError:  # pragma: no cover - concurrent cleanup
+                    pass
+
+    def adopt(self, generation: int, next_seq: int,
+              base_epoch: Optional[int]) -> None:
+        """Continue an existing chain after replay: new appends extend
+        the verified prefix. Segments at/past ``next_seq`` (the dead
+        tail past a stop, superseded by the recovery decision) are
+        removed so the chain stays gap-free."""
+        self.generation = generation
+        self.seq = next_seq
+        self.base_epoch = base_epoch
+        for seq, path in chain_segments(self.dir, self.rank, generation):
+            if seq >= next_seq:
+                try:
+                    os.remove(path)
+                except OSError:  # pragma: no cover - concurrent cleanup
+                    pass

@@ -26,7 +26,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -342,6 +342,28 @@ class SGDLearnerParam(Param):
     # (0 = unbounded) — long-running processes cap their event log
     metrics_max_mb: float = dataclasses.field(default=0.0,
                                               metadata=dict(lo=0))
+    # durability (difacto_tpu/durability, ISSUE 20) — all OFF by
+    # default; the defaults-off build is byte-identical to the
+    # pre-durability path. wal_flush_batches > 0 turns on the
+    # write-ahead delta log: every k dispatched training batches the
+    # touched fused rows are appended as one CRC'd segment
+    # (durability/wal.py), shrinking the recovery point objective from
+    # ckpt_interval epochs to k batches. Single-host hashed-store
+    # streamed training only (init() raises typed errors for
+    # incompatible knobs); forces device_cache_mb=0 (replayed cached
+    # batches bypass the dispatch path the WAL observes).
+    wal_flush_batches: int = 0
+    # comma-separated peer DIRECTORIES (a shared filesystem path or
+    # per-peer mounts) that receive an async copy of each committed
+    # checkpoint family + the live WAL chain (durability/replicate.py).
+    # "" disables. With auto_resume, a host that lost its local dir
+    # recovers by fetching the newest verifying peer replica
+    # (durability/recover.py ladder).
+    replica_peers: str = ""
+    # how many of replica_peers each commit is pushed to (clamped to
+    # the peer count); k >= 2 survives a peer loss concurrent with the
+    # host loss
+    replica_k: int = 1
 
 
 @register("sgd")
@@ -491,8 +513,86 @@ class SGDLearner(Learner):
                     "store assigns slots per-host outside the mesh "
                     "schedule, so hosts would train independent models "
                     "that never synchronize")
+        self._init_durability()
         self._build_steps()
         return remain
+
+    def _init_durability(self) -> None:
+        """Durability legs (ISSUE 20, difacto_tpu/durability): the
+        write-ahead delta log and the async peer replicator. Both
+        default OFF; the WAL's compatibility gates raise TYPED errors
+        (the SlotStore cold-tier precedent) because every listed knob
+        changes rows outside the dispatch path the WAL observes —
+        silently missing those writes would make replay silently
+        wrong, the one failure mode this subsystem exists to exclude."""
+        p = self.param
+        self._wal = None
+        self._replica = None
+        self._wal_touched: list = []
+        self._wal_step = 0
+        self._wal_lo = 0
+        self._wal_epoch = 0
+        # batches of the re-entered epoch whose effects a WAL replay
+        # already applied — the recovery ladder arms this and the
+        # dispatch path fast-forwards past them (durability/recover.py)
+        self._wal_skip = 0
+        if p.wal_flush_batches > 0:
+            if not p.model_out:
+                raise ValueError(
+                    "wal_flush_batches requires model_out: the delta "
+                    "log lives in <model_out>.wal/")
+            if not self.store.hashed:
+                raise ValueError(
+                    "wal_flush_batches requires the hashed store "
+                    "(hash_capacity > 0): dictionary slots are assigned "
+                    "at consume time, so a replayed delta has no stable "
+                    "row space to land in")
+            if self.mesh is not None or self._num_hosts > 1:
+                raise ValueError(
+                    "wal_flush_batches is single-host/flat-device only: "
+                    "mesh and multi-host runs mutate rows through the "
+                    "SPMD exchange, outside the dispatch path the WAL "
+                    "observes")
+            if self.store.tier is not None:
+                raise ValueError(
+                    "wal_flush_batches is incompatible with "
+                    "cold_tier_rows: tier promotes/demotes rewrite rows "
+                    "off the dispatch path, so replay would miss them")
+            if self.store.param.evict_occupancy > 0:
+                raise ValueError(
+                    "wal_flush_batches is incompatible with "
+                    "evict_occupancy: epoch-boundary eviction resets "
+                    "rows outside the dispatch path the WAL observes")
+            if p.device_dedup:
+                raise ValueError(
+                    "wal_flush_batches is incompatible with "
+                    "device_dedup: panel_raw payloads derive slots "
+                    "in-step and carry no host slots section to log")
+            if p.device_cache_mb:
+                # not an error — 2048 is the default: cached batches
+                # replay from HBM through _replay_cached, bypassing the
+                # dispatch path the WAL observes, so the cache is
+                # forced off while the delta log runs
+                log.info("wal_flush_batches: forcing device_cache_mb=0 "
+                         "(HBM-replayed batches bypass the WAL's "
+                         "dispatch hook)")
+                self.param = dataclasses.replace(self.param,
+                                                 device_cache_mb=0)
+                p = self.param
+            from ..durability.wal import WalWriter, wal_dir
+            from ..obs import counter as _gcounter
+            self._wal = WalWriter(wal_dir(p.model_out), self._host_rank,
+                                  self.store.wal_geometry())
+            self._wal_fail_c = _gcounter(
+                "wal_append_failures_total",
+                "WAL segment appends that failed (window retained and "
+                "retried at the next flush boundary)")
+        if p.replica_peers and p.model_out:
+            from ..durability.replicate import Replicator, parse_peers
+            self._replica = Replicator(
+                parse_peers(p.replica_peers), p.replica_k,
+                self._host_rank,
+                root=os.path.dirname(p.model_out) or ".")
 
     def _build_steps(self) -> None:
         from ..ops.batch import unpack_batch
@@ -785,7 +885,13 @@ class SGDLearner(Learner):
 
         if p.model_out:
             log.info("saving final model...")
-            self.store.save(self._model_name(p.model_out, -1), p.has_aux)
+            final = self._model_name(p.model_out, -1)
+            self.store.save(final, p.has_aux)
+            if self._replica is not None:
+                # the final model replicates too (stop() drains the
+                # queue, so exit implies the peers hold it)
+                import glob as _glob
+                self._replica.push(sorted(_glob.glob(final + "*")))
         if self.store.fs_count > 1 or self.store.hashed:
             # per-shard occupancy gauges (docs/observability.md): one
             # full-table host read at run end, never per step. Hashed
@@ -798,6 +904,11 @@ class SGDLearner(Learner):
         if self._fo_pred is not None:
             self._fo_pred.close()
             self._fo_pred = None
+        if getattr(self, "_replica", None) is not None:
+            # drain the push queue before exit: the last commit's
+            # replica is the one a disk-loss recovery will need
+            self._replica.close()
+            self._replica = None
         if self._flusher is not None:
             self._flusher.close()
             self._flusher = None
@@ -810,8 +921,13 @@ class SGDLearner(Learner):
         epoch-cadence path (run) and the wall-clock cadence of the
         online trainer (online/trainer.py)."""
         p = self.param
-        self.store.save(self._model_name(p.model_out, epoch),
-                        save_aux=True, epoch=epoch)
+        if self._wal is not None:
+            # seal the open delta window first: the checkpoint then
+            # supersedes every segment of the outgoing chain, and
+            # rebase below roots a fresh chain at the new generation
+            self._wal_flush()
+        path = self._model_name(p.model_out, epoch)
+        self.store.save(path, save_aux=True, epoch=epoch)
         if self._host_rank == 0:
             self._write_ckpt_meta(epoch)
             if p.ckpt_keep > 0:
@@ -822,9 +938,39 @@ class SGDLearner(Learner):
                 # gone (ROADMAP leftover from PR 3). Safe concurrently
                 # with peers still writing: only epochs older than the
                 # newest ckpt_keep are removed, and no rank rewrites an
-                # old generation.
+                # old generation. ``protect`` (computed BEFORE the WAL
+                # rebase below) pins the base epoch a live delta chain
+                # or an in-flight replica push still references —
+                # retiring either would orphan the chain / tear the
+                # peer's copy (ISSUE 20 bugfix).
                 from ..utils import manifest as mft
-                mft.prune_checkpoints(p.model_out, p.ckpt_keep)
+                mft.prune_checkpoints(
+                    p.model_out, p.ckpt_keep,
+                    protect=self._durability_protected_epochs())
+        if self._wal is not None or self._replica is not None:
+            from ..utils import manifest as mft
+            man = mft.read(path) or {}
+            gen = int(man.get("generation", 0))
+            if self._wal is not None:
+                self._wal.rebase(gen, epoch)
+            if self._replica is not None:
+                import glob as _glob
+                files = sorted(_glob.glob(path + "*"))
+                if self._host_rank == 0:
+                    files.append(self._meta_path())
+                self._replica.push(files, generation=gen, epoch=epoch)
+
+    def _durability_protected_epochs(self) -> set:
+        """Epochs ``ckpt_keep`` pruning must not retire right now: the
+        base generation the live WAL chain is rooted at, plus any epoch
+        an in-flight replica push still references. Released naturally
+        — the next rebase / drained push stops reporting them."""
+        prot: set = set()
+        if self._wal is not None and self._wal.base_epoch is not None:
+            prot.add(self._wal.base_epoch)
+        if self._replica is not None:
+            prot |= self._replica.protected_epochs()
+        return prot
 
     # ----------------------------------------------------------- epochs
     def _model_name(self, prefix: str, it: int) -> str:
@@ -846,9 +992,29 @@ class SGDLearner(Learner):
             f.write(json.dumps({"last_epoch": epoch}))
 
     def _try_resume(self) -> Optional[int]:
+        """auto_resume entry point. With the durability legs OFF this
+        is exactly the classic local generation walk-back
+        (:meth:`_try_resume_base` — the defaults-off build stays
+        byte-identical to the pre-durability path). With
+        ``wal_flush_batches`` / ``replica_peers`` on, resume climbs the
+        recovery ladder instead: local walk-back -> peer replica fetch
+        -> WAL replay to head (durability/recover.py), arming
+        ``_wal_skip`` when the replayed head sits mid-epoch. Returns
+        the last completed epoch (may be -1: WAL-only progress on a
+        virgin base) or None."""
+        if getattr(self, "_wal", None) is None \
+                and not self.param.replica_peers:
+            got = self._try_resume_base()
+            return got[0] if got is not None else None
+        from ..durability import recover
+        return recover.run_ladder(self)
+
+    def _try_resume_base(self) -> Optional[Tuple[int, str]]:
         """Load the newest interval checkpoint THAT VERIFIES
         (ckpt_interval/auto_resume; the recovery leg of parallel/fault.py).
-        Returns the completed epoch or None.
+        Returns (completed epoch, loaded checkpoint path) or None — the
+        path lets the recovery ladder read the base generation its WAL
+        replay chains onto.
 
         Candidates come from the meta marker AND a direct ``_iter-*``
         scan — a crash mid-checkpoint can leave a torn part behind the
@@ -886,7 +1052,7 @@ class SGDLearner(Learner):
                 try:
                     self.store.load(base + str(rank),
                                     require_manifest=True)
-                    return epoch
+                    return epoch, base + str(rank)
                 except (FileNotFoundError, OSError):
                     continue
                 except CheckpointCorrupt as e:
@@ -1960,6 +2126,15 @@ class SGDLearner(Learner):
         pool (data/producer_pool.py) and consumed in canonical order."""
         import os
         p = self.param
+        if job_type == K_TRAINING and self._wal is not None:
+            # new delta window per training epoch: step numbering is
+            # (epoch, step-within-epoch) so a replayed chain can name
+            # the exact batch boundary it recovered to. _wal_skip (the
+            # recovery fast-forward) deliberately survives this reset.
+            self._wal_epoch = epoch
+            self._wal_step = 0
+            self._wal_lo = 0
+            self._wal_touched = []
         cache = self._get_cache(job_type)
         stream_parts = list(range(n_jobs))
         if cache is not None and cache.ready:
@@ -2234,6 +2409,12 @@ class SGDLearner(Learner):
                 pending = []
         while lookahead:
             dispatch_entry(lookahead.popleft())
+        if job_type == K_TRAINING and self._wal is not None:
+            # seal the epoch with a boundary segment (written even when
+            # the window is empty): replay reads it as "this epoch
+            # completed", so a crash after here resumes at the next
+            # epoch instead of re-entering this one with a skip
+            self._wal_flush(boundary=True)
         self._final_merge(job_type, pending, prog)
         retire(keep=0)
         # process mode: the workers' parse/pack/ring-wait seconds arrived
@@ -2492,6 +2673,17 @@ class SGDLearner(Learner):
         arrays may be numpy (direct path) or already on device
         (_stage_payload's double-buffered path)."""
         is_train = job_type == K_TRAINING
+        if is_train and self._wal is not None and self._wal_skip > 0:
+            # recovery fast-forward (durability/recover.py): this
+            # batch's effects were already applied by WAL replay —
+            # deterministic data order makes the skipped prefix exactly
+            # the replayed prefix, so the continued trajectory is the
+            # unkilled one. Advancing _wal_lo keeps the first post-skip
+            # window full-width instead of flushing immediately.
+            self._wal_skip -= 1
+            self._wal_step += 1
+            self._wal_lo = self._wal_step
+            return
         t0 = time.perf_counter()
         if payload[0] == "panel_chunked":
             # producer-side chunked layout (stream_chunks): the host
@@ -2531,6 +2723,8 @@ class SGDLearner(Learner):
                            binary, blk.size)
         self._dispatch_packed(job_type, dev_payload, pending,
                               label=blk.label)
+        if is_train and self._wal is not None:
+            self._wal_touch(layout, i32, b_cap, d2, u_cap)
         if cache is not None and cache.staging and layout != "panel_raw":
             # keep the staged buffers for HBM replay; the counts tail
             # (epoch-0 feature-count push) is zeroed on device so a
@@ -2566,6 +2760,63 @@ class SGDLearner(Learner):
                           (layout, i32, f32, b_cap, d2, u_cap, wc,
                            binary, blk.size),
                           nbytes, capacity=self.store.state.capacity)
+
+    def _wal_touch(self, layout: str, i32, b_cap: int, d2: int,
+                   u_cap: int) -> None:
+        """Record the slots a just-dispatched training batch touched
+        (durability/wal.py). The slots section sits at a fixed offset
+        of the packed i32 buffer — panel: after the [b_cap, width]
+        index panel; COO: after the two [nnz_cap] lanes (data/
+        pack_stream.pack_payload) — so this is one tiny host slice, no
+        repacking. OOB padding lanes (pad_slots_oob) are dropped."""
+        if layout == "coo":
+            off = 2 * d2
+        elif layout == "panel":
+            off = b_cap * d2
+        else:  # pragma: no cover - panel_raw is gated off in init
+            raise RuntimeError(
+                f"WAL cannot observe layout {layout!r}: no host slots "
+                "section")
+        sl = np.asarray(i32[off:off + u_cap]).astype(np.int32)
+        self._wal_touched.append(sl[sl < self.store.state.capacity])
+        self._wal_step += 1
+        if self._wal_step - self._wal_lo >= self.param.wal_flush_batches:
+            self._wal_flush()
+
+    def _wal_flush(self, boundary: bool = False) -> None:
+        """Seal the open delta window as one CRC'd segment: gather the
+        touched rows' CURRENT values from the device (post-step at the
+        window end — the log stores values, not deltas, so a slot's
+        last logged value is its value at head) and append. A failed
+        append (disk error, injected fault) RETAINS the window: the
+        slots stay queued and the next flush logs their values at ITS
+        window end, still correct under value semantics — a transient
+        write failure widens the RPO, never corrupts the chain."""
+        if self._wal is None \
+                or (self._wal_step == self._wal_lo and not boundary):
+            return
+        if self._wal_touched:
+            touched = np.unique(np.concatenate(self._wal_touched))
+            arrays = self.store.wal_touched_rows(touched)
+        else:
+            touched = np.zeros(0, np.int32)
+            arrays = {}
+        from ..utils.faultinject import FaultInjected
+        try:
+            path = self._wal.append(touched, arrays, self._wal_epoch,
+                                    self._wal_lo, self._wal_step,
+                                    boundary=boundary)
+        except (FaultInjected, OSError) as e:
+            self._wal_fail_c.inc()
+            log.warning("wal append failed (%s); window retained to "
+                        "the next flush", e)
+            return
+        self._wal_lo = self._wal_step
+        self._wal_touched = []
+        if path is not None and self._replica is not None:
+            self._replica.push([path],
+                               generation=self._wal.generation,
+                               epoch=self._wal.base_epoch)
 
     def _panel_host_batch(self, cblk, n_uniq: int, b_cap: int, width: int,
                           u_cap: int, dp_div: int, row_base: int = 0,

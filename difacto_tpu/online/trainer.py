@@ -19,7 +19,14 @@ boundary — through the learner's verified-manifest path
 pruning under ``ckpt_keep``; fs-sharded families included). Crash
 recovery is the existing ``auto_resume`` walk-back: the completed epoch
 the learner resumes IS the last trained-through segment, so the trainer
-restarts tailing at the next one.
+restarts tailing at the next one. ``wal_flush_batches`` composes
+unchanged — the WAL/replication/ladder machinery lives entirely inside
+the shared ``_save_checkpoint``/``_try_resume`` paths this trainer
+already drives — and changes the tradeoff the cadence knob expresses:
+with a WAL, ``online_ckpt_interval_s`` prices only checkpoint IO, not
+freshness-vs-durability, because a crash mid-interval replays forward
+from the delta log instead of refalling to the last wall-clock commit
+(docs/serving.md "Durability & recovery").
 
 Freshness SLO gauges (process-global registry, so they ride any
 in-process server's ``#metrics`` and the trainer's ``metrics_path``

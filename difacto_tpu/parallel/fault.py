@@ -54,7 +54,14 @@ def restart_attempt() -> int:
     relaunched cluster REJOINS AT THE CURRENT CLOCK: every survivor and
     the evicted host's replacement restart in the same fresh clock
     epoch, and stale clock keys a dead attempt left in a lingering
-    coordinator can never satisfy a new attempt's window waits."""
+    coordinator can never satisfy a new attempt's window waits.
+
+    The durability ladder (durability/recover.py) composes with this
+    unchanged: a relaunched attempt's ``auto_resume`` climbs local
+    checkpoint → peer fetch → WAL replay exactly like a first launch —
+    nothing here knows about WAL state, and the attempt counter never
+    namespaces durable artifacts (checkpoints, ``.wal/`` chains,
+    replicas), which must survive relaunches by design."""
     try:
         return int(os.environ.get("DIFACTO_RESTART", "0"))
     except ValueError:

@@ -532,6 +532,80 @@ class SlotStore:
             VVg=fused.scatter_rows(self.state.VVg, sl, out)))
         return n
 
+    # --------------------------------------------------- WAL row surgery
+    def wal_geometry(self) -> dict:
+        """The geometry stamp every WAL segment carries and replay
+        validates before applying (durability/wal.py): a delta logged
+        against a different capacity / layout / quantization must stop
+        replay typed, never scatter into the wrong rows."""
+        return {"hash_capacity": int(self.param.hash_capacity),
+                "capacity": int(self.state.capacity),
+                "V_dim": int(self.param.V_dim),
+                "slot_dtype": self.param.slot_dtype,
+                "row_width": int(self.state.VVg.shape[1])}
+
+    def wal_touched_rows(self, slots: np.ndarray) -> dict:
+        """Host copies of the given device rows EXACTLY as the table
+        stores them — fused VVg CONTAINER rows for V_dim > 0 (so a
+        quantized ``slot_dtype`` table logs container bytes and replay
+        is bit-exact with no dequantize round-trip), or the five flat
+        columns of the V_dim = 0 layout. The WAL's append-side read;
+        one small host gather per flush window, off the jit step."""
+        slots = np.asarray(slots, dtype=np.int32)
+        n = len(slots)
+        if n == 0:
+            return {}
+        if self.param.V_dim == 0:
+            sl = jnp.asarray(slots)
+            st = self.state
+            return {k: np.asarray(getattr(st, k)[sl])
+                    for k in ("w", "z", "sqrt_g", "cnt", "v_live")}
+        from ..ops import fused
+        from ..ops.batch import bucket
+        pad = pad_slots_oob(slots, bucket(n), self.state.capacity)
+        rows = fused.gather_rows(self.state.VVg, jnp.asarray(pad))
+        return {"VVg": np.asarray(rows[:n])}
+
+    def apply_wal_rows(self, slots: np.ndarray, arrays: dict) -> int:
+        """Scatter replayed WAL rows back into the table — the inverse
+        of :meth:`wal_touched_rows`, byte-exact by construction (the
+        logged container/column bytes land unchanged). Replay-path only
+        (durability/recover.py), never concurrent with dispatch."""
+        slots = np.asarray(slots, dtype=np.int32)
+        n = len(slots)
+        if n == 0:
+            return 0
+        st = self.state
+        if self.param.V_dim == 0:
+            cols = ("w", "z", "sqrt_g", "cnt", "v_live")
+            for k in cols:
+                if len(arrays[k]) != n:
+                    raise ValueError(
+                        f"WAL column {k!r} has {len(arrays[k])} rows "
+                        f"for {n} slots")
+            sl = jnp.asarray(slots)
+            self.state = self._place(st._replace(**{
+                k: getattr(st, k).at[sl].set(
+                    jnp.asarray(np.asarray(arrays[k]).astype(
+                        getattr(st, k).dtype)))
+                for k in cols}))
+            return n
+        from ..ops import fused
+        from ..ops.batch import bucket
+        width = st.VVg.shape[1]
+        rows = np.asarray(arrays["VVg"]).reshape(n, width)
+        if rows.dtype != st.VVg.dtype:
+            raise ValueError(
+                f"WAL rows are {rows.dtype} but the table stores "
+                f"{st.VVg.dtype}: geometry mismatch")
+        pad = pad_slots_oob(slots, bucket(n), st.capacity)
+        full = np.zeros((len(pad), width), dtype=rows.dtype)
+        full[:n] = rows
+        self.state = self._place(st._replace(
+            VVg=fused.scatter_rows(st.VVg, jnp.asarray(pad),
+                                   jnp.asarray(full))))
+        return n
+
     def capacity_stats(self) -> dict:
         """Effective-capacity accounting of the three levers
         (bench.py --capacity; docs/perf_notes.md "Table capacity"):

@@ -56,7 +56,27 @@ run of a real cluster) arm through one environment variable:
   demote loses nothing), ``store.promote`` (a cold-tier promotion
   batch — fired before the device scatter; ``err`` keeps the missing
   slots cold for this batch only, which reads zeros through the OOB
-  lanes, and the next touch retries the promote).
+  lanes, and the next touch retries the promote), ``wal.append``
+  (sealing one write-ahead delta window as a CRC'd segment,
+  durability/wal.py — ``err`` fails the write and the learner RETAINS
+  the window for the next flush (counted in
+  ``wal_append_failures_total``; the log stores values, so a late
+  segment stays correct), ``truncate`` lands a torn segment at its
+  final name which replay's CRCs must reject as a typed ``WalCorrupt``,
+  ``kill`` dies before any bytes land — the honest mid-window crash
+  the chaos RPO leg arms), ``wal.replay`` (reading one WAL segment at
+  recovery, durability/wal.py — ``err`` is a failed disk read,
+  ``truncate`` reads a half-length view; both must stop replay TYPED
+  at the verified prefix, a consistent earlier batch boundary, never a
+  crash or silently-wrong rows), ``replica.push`` (one file copy of an
+  async peer replication, durability/replicate.py — ``err`` fails the
+  copy, counted in ``replica_push_failures_total``, and the
+  anti-entropy scrub re-pushes it later; ``truncate`` lands a torn
+  file at the peer which the scrub's verification must catch),
+  ``replica.fetch`` (one file copy of a disk-loss recovery fetch —
+  ``err`` is a dead/unreachable peer and must surface typed so the
+  recovery ladder tries the next peer, counted in
+  ``replica_fetch_failures_total``).
 - ``kind`` — what happens when the fault fires:
     - ``err``      raise :class:`FaultInjected` (an OSError, so IO call
                    sites treat it exactly like a real IO failure);

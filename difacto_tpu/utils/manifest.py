@@ -34,7 +34,7 @@ import json
 import logging
 import re
 import zipfile
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -331,14 +331,27 @@ def generation_paths(uri: str) -> List[str]:
 
 
 def prune_checkpoints(model_prefix: str, keep: int,
-                      rank: Optional[int] = None) -> List[str]:
+                      rank: Optional[int] = None,
+                      protect: Optional[Iterable[int]] = None
+                      ) -> List[str]:
     """Retire interval checkpoints older than the newest ``keep`` epochs
     of ``model_prefix``'s family. Only ``_iter-k`` files are candidates —
     the final (undecorated) model is never pruned. With ``rank`` set only
     that rank's ``_part-<rank>`` files are removed (each host prunes what
-    it wrote; no cross-host delete races). Returns the removed paths."""
+    it wrote; no cross-host delete races). Returns the removed paths.
+
+    ``protect`` exempts specific epochs from retirement regardless of
+    age: the durability layer passes the epoch a live WAL chain is
+    rooted at and any epoch an in-flight replica push still references
+    (durability/wal.py, durability/replicate.py) — pruning either would
+    orphan the delta chain (replay has no base to apply onto) or tear
+    the copy a peer is mid-receive on. The retention-count semantics
+    are otherwise unchanged: protected epochs don't consume ``keep``
+    slots, they are simply skipped until their chain rebase / push
+    completion releases them (the next prune retires them normally)."""
     if keep <= 0:
         return []
+    protected = frozenset(int(e) for e in (protect or ()))
     fam = family_prefix(model_prefix)
     by_epoch: Dict[int, List[str]] = {}
     for path in stream.glob(fam + "_iter-*"):
@@ -352,6 +365,8 @@ def prune_checkpoints(model_prefix: str, keep: int,
         by_epoch.setdefault(int(m.group(1)), []).append(path)
     removed = []
     for epoch in sorted(by_epoch)[:-keep]:
+        if epoch in protected:
+            continue
         for path in by_epoch[epoch]:
             for p in (path, manifest_path(path)):
                 try:

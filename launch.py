@@ -48,7 +48,12 @@ detect peer death and abort instead of hanging; when a process dies by
 signal or aborts with EXIT_PEER_DEAD, the launcher kills the stragglers,
 EVICTS one host, and relaunches the survivors — byte-range input sharding
 re-partitions the data over them and training resumes from the last epoch
-checkpoint (SGDLearner ckpt_interval/auto_resume).
+checkpoint (SGDLearner ckpt_interval/auto_resume). A single-host job
+run under the launcher with ``wal_flush_batches``/``replica_peers`` set
+gets the tighter story for free: the relaunch's same auto_resume path
+climbs the durability ladder (local checkpoint → peer fetch → WAL
+replay; docs/serving.md "Durability & recovery") — the launcher itself
+needs no new flags.
 
 Usage:
     python launch.py -n 2 -- python -m difacto_tpu train.conf k=v ...

@@ -1,0 +1,732 @@
+"""Durable-training-state suite (ISSUE 20): the write-ahead delta log,
+peer-replicated shard checkpoints and the bounded-RPO recovery ladder
+(difacto_tpu/durability/), proven under the failures they exist for.
+
+Covers the acceptance legs — segment round-trip (fp32 AND quantized
+container bytes), the corrupt/torn WAL matrix (truncated tail, bit
+flip, missing middle: typed stops at the verified prefix, never
+silently-wrong rows), trajectory invariance (WAL on == WAL off, byte
+identical), the four armed fault points (``wal.append`` /
+``wal.replay`` / ``replica.push`` / ``replica.fetch``), the
+``ckpt_keep``-vs-live-chain pruning regression, replication
+push/scrub/lag, the recovery ladder rungs, and the deterministic
+SIGKILL-mid-window + disk-loss chaos leg (relaunch recovers via peer
+replica + WAL replay; replayed-forward work bounded by one flush
+window; byte-identical final state vs the unkilled reference run).
+
+Conventions follow tests/test_chaos.py: SIGALRM deadlines around
+subprocess legs, the ``chaos`` marker (tier-1; ``make
+durability-chaos`` selects this file's tests), injected faults
+disarmed after every test.
+"""
+
+import contextlib
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from difacto_tpu.__main__ import main
+from difacto_tpu.durability import replicate, wal
+from difacto_tpu.durability.replicate import Replicator
+from difacto_tpu.durability.wal import WalCorrupt, WalWriter
+from difacto_tpu.learners.sgd import SGDLearner
+from difacto_tpu.store.local import K_FEACOUNT, K_GRADIENT, SlotStore
+from difacto_tpu.updaters.sgd_updater import SGDUpdaterParam
+from difacto_tpu.utils import faultinject
+from difacto_tpu.utils import manifest as mft
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.chaos
+
+FLUSH = 4  # wal_flush_batches used by the learner-level legs
+
+
+@contextlib.contextmanager
+def deadline(seconds: int):
+    def on_alarm(signum, frame):
+        raise TimeoutError(f"test exceeded {seconds}s deadline")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No injected fault may leak across tests."""
+    yield
+    faultinject.configure("")
+
+
+def train_args(rcv1_path, model, epochs=3, extra=()):
+    # batch_size=10 -> 10 batches/epoch over the 100-row fixture, so a
+    # FLUSH=4 window seals at steps 4, 8 and the epoch boundary (10);
+    # hashed store: the WAL requires a stable replayable row space
+    return [f"data_in={rcv1_path}", "lr=1", "l1=1", "l2=1",
+            "batch_size=10", f"max_num_epochs={epochs}", "shuffle=0",
+            "num_jobs_per_epoch=1", "report_interval=0",
+            "stop_rel_objv=0", "hash_capacity=4096",
+            f"model_out={model}", *extra]
+
+
+def _mk_store(**kw) -> SlotStore:
+    base = dict(hash_capacity=64, V_dim=4, V_threshold=0, lr=0.1,
+                V_lr=0.1)
+    base.update(kw)
+    p, rest = SGDUpdaterParam.init_allow_unknown(
+        [(k, str(v)) for k, v in base.items()])
+    assert rest == []
+    return SlotStore(p)
+
+
+def _train_store(st: SlotStore, keys: np.ndarray, rounds: int = 3,
+                 seed: int = 0) -> None:
+    rng = np.random.default_rng(seed)
+    for _ in range(rounds):
+        k = np.sort(rng.choice(keys, size=min(8, len(keys)),
+                               replace=False))
+        st.push(k, K_FEACOUNT, np.ones(len(k), np.float32))
+        st.pull(k)
+        g = rng.standard_normal(len(k)).astype(np.float32) * 0.1
+        gV = rng.standard_normal(
+            (len(k), st.param.V_dim)).astype(np.float32) * 0.01
+        st.push(k, K_GRADIENT, g, gV, np.ones(len(k), bool))
+
+
+def _npz_arrays(path: str) -> dict:
+    """Every array of a checkpoint file (np.load detects the zip by
+    magic; checkpoint files carry no extension)."""
+    with np.load(path, allow_pickle=False) as z:
+        return {k: np.asarray(z[k]) for k in z.files}
+
+
+def _assert_same_arrays(a: dict, b: dict) -> None:
+    assert sorted(a) == sorted(b)
+    for k in a:
+        av, bv = a[k], b[k]
+        assert av.dtype == bv.dtype and av.shape == bv.shape, k
+        assert av.tobytes() == bv.tobytes(), f"array {k!r} differs"
+
+
+# ------------------------------------------------- WAL segment format
+
+def test_wal_segment_roundtrip_fp32_and_flat(tmp_path):
+    """write/read round-trip of both payload layouts: fused VVg rows
+    and the five flat V_dim=0 columns — dtype and bytes preserved."""
+    rng = np.random.RandomState(3)
+    meta = {"generation": 2, "seq": 0, "rank": 0, "epoch": 1,
+            "step_lo": 0, "step_hi": 4, "boundary": False,
+            "hash_capacity": 64, "capacity": 64, "V_dim": 4,
+            "slot_dtype": "fp32", "row_width": 10}
+    sects = {"slots": np.array([1, 5, 9], np.int32),
+             "VVg": rng.randn(3, 10).astype(np.float32)}
+    p = str(tmp_path / "seg.dfwal")
+    n = wal.write_segment(p, meta, sects)
+    assert n == os.path.getsize(p)
+    got_meta, got = wal.read_segment(p)
+    assert got_meta == meta
+    assert got["slots"].tolist() == [1, 5, 9]
+    assert got["VVg"].tobytes() == sects["VVg"].tobytes()
+    assert got["VVg"].dtype == np.float32
+
+    flat = {"slots": np.array([0, 2], np.int32),
+            "w": rng.randn(2).astype(np.float32),
+            "z": rng.randn(2).astype(np.float32),
+            "sqrt_g": rng.rand(2).astype(np.float32),
+            "cnt": np.array([3.0, 7.0], np.float32),
+            "v_live": np.array([True, False])}
+    p2 = str(tmp_path / "flat.dfwal")
+    wal.write_segment(p2, meta, flat)
+    _, got2 = wal.read_segment(p2)
+    for k, v in flat.items():
+        assert got2[k].dtype == v.dtype and got2[k].tobytes() == \
+            v.tobytes(), k
+
+
+def test_wal_segment_roundtrip_quantized_containers(tmp_path):
+    """Quantization-aware: bf16 and fp8 CONTAINER rows (ml_dtypes — no
+    buffer-protocol format char) round-trip bit-exact by name."""
+    import ml_dtypes
+    rng = np.random.RandomState(5)
+    meta = {"epoch": 0, "step_lo": 0, "step_hi": 1}
+    for dt in (ml_dtypes.bfloat16, ml_dtypes.float8_e4m3fn):
+        rows = rng.randn(4, 6).astype(np.float32).astype(dt)
+        p = str(tmp_path / f"{np.dtype(dt).name}.dfwal")
+        wal.write_segment(p, meta, {"slots": np.arange(4, dtype=np.int32),
+                                    "VVg": rows})
+        _, got = wal.read_segment(p)
+        assert got["VVg"].dtype == np.dtype(dt)
+        assert got["VVg"].tobytes() == rows.tobytes()
+
+
+def test_wal_corrupt_matrix_typed(tmp_path):
+    """Truncated tail, payload bit flip, bad magic and a too-short file
+    all surface as the typed WalCorrupt naming the file — never a
+    struct crash or a silent short read."""
+    meta = {"epoch": 0, "step_lo": 0, "step_hi": 1}
+    good = str(tmp_path / "good.dfwal")
+    wal.write_segment(good, meta, {
+        "slots": np.arange(8, dtype=np.int32),
+        "VVg": np.ones((8, 4), np.float32)})
+    buf = open(good, "rb").read()
+
+    torn = str(tmp_path / "torn.dfwal")
+    open(torn, "wb").write(buf[:len(buf) // 2])
+    flip = str(tmp_path / "flip.dfwal")
+    fb = bytearray(buf)
+    fb[-3] ^= 0xFF  # inside the last section's payload
+    open(flip, "wb").write(bytes(fb))
+    magic = str(tmp_path / "magic.dfwal")
+    open(magic, "wb").write(b"NOTAWAL!" + buf[8:])
+    short = str(tmp_path / "short.dfwal")
+    open(short, "wb").write(buf[:4])
+
+    for p in (torn, flip, magic, short):
+        with pytest.raises(WalCorrupt) as ei:
+            wal.read_segment(p)
+        assert p in str(ei.value)
+    # the intact segment still reads: corruption detection, not refusal
+    wal.read_segment(good)
+
+
+def test_wal_writer_chain_rebase_adopt(tmp_path):
+    d = str(tmp_path / "m.wal")
+    w = WalWriter(d, rank=0, geom={"capacity": 64}, generation=3)
+    rows = np.ones((2, 4), np.float32)
+    for i in range(3):
+        w.append(np.array([i, i + 1], np.int32), {"VVg": rows},
+                 epoch=0, step_lo=i * 4, step_hi=(i + 1) * 4)
+    assert [s for s, _ in wal.chain_segments(d, 0, 3)] == [0, 1, 2]
+    # an empty non-boundary window writes nothing; a boundary marker does
+    assert w.append(np.array([], np.int32), {}, 0, 12, 12) is None
+    assert w.append(np.array([], np.int32), {}, 0, 12, 12,
+                    boundary=True) is not None
+    # rebase to generation 5: keep_generations=2 retires chains < 4
+    w.rebase(5, epoch=1)
+    assert (w.generation, w.seq, w.base_epoch) == (5, 0, 1)
+    assert wal.chain_generations(d, 0) == []  # gen-3 chain retired
+    w.append(np.array([1], np.int32), {"VVg": rows[:1]}, 1, 0, 4)
+    w.append(np.array([2], np.int32), {"VVg": rows[:1]}, 1, 4, 8)
+    # adopt after a replay that verified only seq 0: the dead tail goes
+    w2 = WalWriter(d, rank=0, geom={"capacity": 64})
+    w2.adopt(5, next_seq=1, base_epoch=1)
+    assert [s for s, _ in wal.chain_segments(d, 0, 5)] == [0]
+    assert (w2.generation, w2.seq, w2.base_epoch) == (5, 1, 1)
+
+
+# ------------------------------------------------- store hooks + replay
+
+def test_store_wal_rows_roundtrip_fused_and_flat():
+    """wal_touched_rows -> apply_wal_rows is byte-exact for both state
+    layouts: a fresh same-seed store replayed to equals the source."""
+    keys = np.arange(2, 40, dtype=np.uint64)
+    for kw in (dict(V_dim=4), dict(V_dim=0),
+               dict(V_dim=4, slot_dtype="bf16")):
+        src = _mk_store(**kw)
+        _train_store(src, keys)
+        slots = np.unique(src.lookup(keys))
+        slots = slots[(slots >= 0) & (slots < src.state.capacity)]
+        rows = src.wal_touched_rows(slots)
+        dst = _mk_store(**kw)  # same seed -> identical init state
+        dst.apply_wal_rows(slots, rows)
+        if kw.get("V_dim"):
+            a = np.asarray(src.state.VVg)
+            b = np.asarray(dst.state.VVg)
+            assert a.tobytes() == b.tobytes()
+        else:
+            for col in ("w", "z", "sqrt_g", "cnt", "v_live"):
+                assert np.asarray(getattr(src.state, col)).tobytes() == \
+                    np.asarray(getattr(dst.state, col)).tobytes(), col
+
+
+def test_replay_applies_chain_and_stops_typed(tmp_path):
+    """A 3-segment chain replays to the head; a missing middle segment
+    stops at the verified prefix typed 'gap'; a torn tail stops 'torn';
+    a geometry mismatch stops 'geometry'. Nothing past a stop is ever
+    applied."""
+    keys = np.arange(2, 40, dtype=np.uint64)
+    src = _mk_store()
+    d = str(tmp_path / "m.wal")
+    w = WalWriter(d, rank=0, geom=src.wal_geometry(), generation=1)
+    snaps = []
+    for i in range(3):
+        _train_store(src, keys, rounds=1, seed=i)
+        slots = np.unique(src.lookup(keys))
+        slots = slots[(slots >= 0) & (slots < src.state.capacity)]
+        w.append(slots, src.wal_touched_rows(slots), epoch=0,
+                 step_lo=i * FLUSH, step_hi=(i + 1) * FLUSH)
+        snaps.append(np.asarray(src.state.VVg).copy())
+
+    dst = _mk_store()
+    res = wal.replay(dst, d, 0, 1, base_epoch=-1)
+    assert (res.segments, res.batches, res.stopped) == (3, 3 * FLUSH, "")
+    assert (res.epoch, res.step, res.boundary) == (0, 3 * FLUSH, False)
+    assert np.asarray(dst.state.VVg).tobytes() == snaps[2].tobytes()
+
+    # missing middle -> gap: only seq 0 applies
+    miss = str(tmp_path / "miss.wal")
+    os.makedirs(miss)
+    for seq, p in wal.chain_segments(d, 0, 1):
+        if seq != 1:
+            os.link(p, os.path.join(miss, os.path.basename(p)))
+    dst = _mk_store()
+    res = wal.replay(dst, miss, 0, 1, base_epoch=-1)
+    assert (res.segments, res.stopped) == (1, "gap")
+    assert res.step == FLUSH
+    assert np.asarray(dst.state.VVg).tobytes() == snaps[0].tobytes()
+
+    # torn tail -> torn: segments 0..1 apply, the half-written 2 not
+    torn = str(tmp_path / "torn.wal")
+    os.makedirs(torn)
+    for seq, p in wal.chain_segments(d, 0, 1):
+        q = os.path.join(torn, os.path.basename(p))
+        buf = open(p, "rb").read()
+        open(q, "wb").write(buf[:len(buf) // 2] if seq == 2 else buf)
+    dst = _mk_store()
+    res = wal.replay(dst, torn, 0, 1, base_epoch=-1)
+    assert (res.segments, res.stopped) == (2, "torn")
+    assert np.asarray(dst.state.VVg).tobytes() == snaps[1].tobytes()
+
+    # a differently-shaped table refuses the whole chain typed
+    dst = _mk_store(hash_capacity=128)
+    res = wal.replay(dst, d, 0, 1, base_epoch=-1)
+    assert (res.segments, res.stopped) == (0, "geometry")
+
+
+# ----------------------------------------------------- learner gating
+
+def test_wal_init_gates_typed(rcv1_path, tmp_path):
+    model = str(tmp_path / "m")
+
+    def init(extra):
+        ln = SGDLearner()
+        ln.init([tuple(kv.split("=", 1)) for kv in
+                 train_args(rcv1_path, model, extra=extra)])
+        return ln
+
+    with pytest.raises(ValueError, match="requires model_out"):
+        ln = SGDLearner()
+        args = [kv for kv in train_args(rcv1_path, model,
+                                        extra=("wal_flush_batches=4",))
+                if not kv.startswith("model_out=")]
+        ln.init([tuple(kv.split("=", 1)) for kv in args] +
+                [("model_out", "")])
+    with pytest.raises(ValueError, match="hashed store"):
+        ln = SGDLearner()
+        args = [kv for kv in train_args(rcv1_path, model,
+                                        extra=("wal_flush_batches=4",))
+                if not kv.startswith("hash_capacity=")]
+        ln.init([tuple(kv.split("=", 1)) for kv in args])
+    with pytest.raises(ValueError, match="evict_occupancy"):
+        init(("wal_flush_batches=4", "evict_occupancy=0.5"))
+    with pytest.raises(ValueError, match="cold_tier_rows"):
+        init(("wal_flush_batches=4", "V_dim=4", "cold_tier_rows=64"))
+
+    # defaults-off: no WAL, no replicator, resume is the classic path
+    ln = init(())
+    assert ln._wal is None and ln._replica is None
+    ln.stop()
+    # on: the writer exists and the device replay cache is forced off
+    ln = init(("wal_flush_batches=4",))
+    assert ln._wal is not None and ln.param.device_cache_mb == 0
+    ln.stop()
+
+
+def test_trajectory_invariance_wal_on_off(rcv1_path, tmp_path):
+    """The WAL observes the dispatch path, it must not perturb it: the
+    final model of a WAL-on run is byte-identical to the WAL-off run —
+    for the flat AND the fused (V_dim>0) layouts.
+    (device_cache_mb=0 on both legs: WAL-on forces it off.)"""
+    for tag, extra in (("flat", ()), ("fused", ("V_dim=8",))):
+        off = str(tmp_path / f"off_{tag}")
+        on = str(tmp_path / f"on_{tag}")
+        base = ("device_cache_mb=0",) + extra
+        assert main(train_args(rcv1_path, off, extra=base)) == 0
+        assert main(train_args(
+            rcv1_path, on,
+            extra=base + ("ckpt_interval=1", f"wal_flush_batches={FLUSH}",
+                          "auto_resume=1"))) == 0
+        _assert_same_arrays(_npz_arrays(off + "_part-0"),
+                            _npz_arrays(on + "_part-0"))
+        # and the WAL-on run actually logged: a live chain exists
+        assert wal.chain_generations(wal.wal_dir(on), 0)
+
+
+# ------------------------------------------------ armed fault points
+
+def test_fault_wal_append_err_retains_window(tmp_path):
+    """Armed ``wal.append:err``: the append raises the typed
+    FaultInjected, the writer's chain position does NOT advance, and
+    the retried append (fault cleared) lands at the same seq — the
+    learner-side contract that a failed flush retains its window."""
+    d = str(tmp_path / "m.wal")
+    w = WalWriter(d, 0, {"capacity": 64})
+    faultinject.configure("wal.append:err@1")
+    with pytest.raises(faultinject.FaultInjected):
+        w.append(np.array([1], np.int32),
+                 {"VVg": np.ones((1, 4), np.float32)}, 0, 0, 4)
+    assert faultinject.stats() == {"wal.append": 1}
+    assert w.seq == 0 and wal.chain_segments(d, 0, 0) == []
+    faultinject.configure("")
+    w.append(np.array([1], np.int32),
+             {"VVg": np.ones((1, 4), np.float32)}, 0, 0, 8)
+    assert [s for s, _ in wal.chain_segments(d, 0, 0)] == [0]
+
+
+def test_fault_wal_append_truncate_is_rejected_at_replay(tmp_path):
+    """Armed ``wal.append:truncate``: the torn segment lands at its
+    FINAL name (the crash-mid-write shape) and replay's CRCs reject it
+    typed — applying nothing from it."""
+    st = _mk_store()
+    d = str(tmp_path / "m.wal")
+    w = WalWriter(d, 0, st.wal_geometry())
+    faultinject.configure("wal.append:truncate@1")
+    p = w.append(np.array([1, 2], np.int32),
+                 st.wal_touched_rows(np.array([1, 2], np.int32)),
+                 0, 0, 4)
+    faultinject.configure("")
+    assert p is not None and os.path.exists(p)
+    with pytest.raises(WalCorrupt):
+        wal.read_segment(p)
+    res = wal.replay(_mk_store(), d, 0, 0, base_epoch=-1)
+    assert (res.segments, res.stopped) == (0, "torn")
+
+
+def test_fault_wal_replay_truncate_stops_at_prefix(tmp_path):
+    """Armed ``wal.replay:truncate`` on the SECOND read: replay applies
+    segment 0, stops typed at the injected half-length view of segment
+    1 — the verified prefix, not a crash."""
+    st = _mk_store()
+    keys = np.arange(2, 20, dtype=np.uint64)
+    d = str(tmp_path / "m.wal")
+    w = WalWriter(d, 0, st.wal_geometry())
+    for i in range(2):
+        _train_store(st, keys, rounds=1, seed=i)
+        slots = np.unique(st.lookup(keys))
+        slots = slots[(slots >= 0) & (slots < st.state.capacity)]
+        w.append(slots, st.wal_touched_rows(slots), 0,
+                 i * FLUSH, (i + 1) * FLUSH)
+    faultinject.configure("wal.replay:truncate@1:1")
+    res = wal.replay(_mk_store(), d, 0, 0, base_epoch=-1)
+    assert faultinject.stats() == {"wal.replay": 1}
+    assert (res.segments, res.batches, res.stopped) == (1, FLUSH, "torn")
+
+
+def test_fault_replica_push_err_then_scrub_repairs(tmp_path):
+    """Armed ``replica.push:err``: the async push fails counted, the
+    peer stays incomplete; the anti-entropy scrub (fault cleared)
+    detects and re-pushes — and a ``truncate``-torn .dfwal at the peer
+    is caught by the scrub's CRC verification."""
+    root = tmp_path / "local"
+    peer = tmp_path / "peer"
+    root.mkdir(), peer.mkdir()
+    model = str(root / "m")
+    # a family: one WAL segment + the .meta sidecar
+    w = WalWriter(wal.wal_dir(model), 0, {"capacity": 64})
+    seg = w.append(np.array([1], np.int32),
+                   {"VVg": np.ones((1, 4), np.float32)}, 0, 0, 4)
+    with open(model + ".meta", "w") as f:
+        f.write(json.dumps({"last_epoch": 0}))
+
+    from difacto_tpu.obs import counter
+    fail_c = counter("replica_push_failures_total", "")
+    before = fail_c.value()
+    r = Replicator([str(peer)], k=1, rank=0, root=str(root))
+    try:
+        faultinject.configure("replica.push:err@1")
+        r.push([seg, model + ".meta"], generation=1, epoch=0)
+        assert r.flush(timeout=30)
+        assert faultinject.stats()["replica.push"] >= 1
+        assert fail_c.value() >= before + 2
+        assert not os.path.exists(peer / "m.wal" /
+                                  os.path.basename(seg))
+        # scrub with the fault cleared repairs both files
+        faultinject.configure("")
+        assert r.scrub(model) == 2
+        assert open(peer / "m.meta").read() == \
+            open(model + ".meta").read()
+        wal.read_segment(str(peer / "m.wal" / os.path.basename(seg)))
+        # a torn peer segment (the truncate kind) is detected + repaired
+        faultinject.configure("replica.push:truncate@1")
+        replicate.push_file(seg, str(peer), str(root))
+        faultinject.configure("")
+        with pytest.raises(WalCorrupt):
+            wal.read_segment(str(peer / "m.wal" /
+                                 os.path.basename(seg)))
+        assert r.scrub(model) == 1
+        wal.read_segment(str(peer / "m.wal" / os.path.basename(seg)))
+        assert r.scrub(model) == 0  # converged: nothing left to repair
+    finally:
+        r.close()
+
+
+def test_fault_replica_fetch_err_tries_next_peer(rcv1_path, tmp_path):
+    """Armed ``replica.fetch:err``: a fetch failure is typed and
+    counted, never a crash; and a peer whose family is incomplete fails
+    that peer only — the ladder's fetch moves to the next peer and
+    restores the full family from it."""
+    model = str(tmp_path / "src" / "m")
+    os.makedirs(tmp_path / "src")
+    assert main(train_args(rcv1_path, model,
+                           extra=("ckpt_interval=1",))) == 0
+    # equal generations tie-break by path DESCENDING: z_bad ranks first
+    pbad = tmp_path / "z_bad_peer"
+    pgood = tmp_path / "a_good_peer"
+    pbad.mkdir(), pgood.mkdir()
+    fam = replicate.family_files(model)
+    assert fam
+    for peer in (pbad, pgood):
+        for f in fam:
+            replicate.push_file(f, str(peer), str(tmp_path / "src"))
+
+    # every fetch fails typed -> None, counted, no exception escapes
+    faultinject.configure("replica.fetch:err@1")
+    lost = str(tmp_path / "lost" / "m")
+    os.makedirs(tmp_path / "lost")
+    assert replicate.fetch_family(lost, [str(pbad), str(pgood)]) is None
+    assert faultinject.stats()["replica.fetch"] >= 1
+    faultinject.configure("")
+
+    # the first-ranked peer's newest checkpoint is unreadable (a
+    # directory squats its name): its fetch fails typed mid-family and
+    # the next peer serves the full restore
+    os.remove(pbad / "m_iter-2_part-0")
+    os.mkdir(pbad / "m_iter-2_part-0")
+    used = replicate.fetch_family(lost, [str(pbad), str(pgood)])
+    assert used == str(pgood)
+    for f in fam:
+        rel = os.path.relpath(f, str(tmp_path / "src"))
+        assert open(os.path.join(tmp_path / "lost", rel), "rb").read() \
+            == open(f, "rb").read()
+
+
+# ------------------------------------------- pruning regression (bugfix)
+
+def test_prune_checkpoints_protect_exempts_epochs(tmp_path):
+    model = str(tmp_path / "m")
+    for e in range(4):
+        for suf in ("", mft.MANIFEST_SUFFIX):
+            with open(f"{model}_iter-{e}_part-0{suf}", "w") as f:
+                f.write("x")
+    removed = mft.prune_checkpoints(model, keep=1, protect={1})
+    left = sorted(f for f in os.listdir(tmp_path)
+                  if not f.endswith(".json"))
+    # epochs 0 and 2 retired; 1 survives protected, 3 by keep=1 — and
+    # protected epochs do not consume keep slots
+    assert left == ["m_iter-1_part-0", "m_iter-3_part-0"]
+    assert sorted(removed) == [f"{model}_iter-0_part-0",
+                               f"{model}_iter-2_part-0"]
+
+
+def test_ckpt_keep_never_retires_live_wal_base(rcv1_path, tmp_path):
+    """Regression (ISSUE 20 bugfix): at each interval save the prune
+    runs BEFORE the chain rebases onto the new generation, so with
+    ``ckpt_keep=1`` the un-protected pruner would retire the epoch the
+    live chain is still rooted at — orphaning every delta if the
+    process died between prune and rebase. The base epoch must survive
+    its own save and be retired only by the NEXT one."""
+    model = str(tmp_path / "m")
+    ln = SGDLearner()
+    ln.init([tuple(kv.split("=", 1)) for kv in train_args(
+        rcv1_path, model,
+        extra=("ckpt_interval=1", "ckpt_keep=1",
+               f"wal_flush_batches={FLUSH}"))])
+    try:
+        ln._save_checkpoint(0)
+        assert ln._wal.base_epoch == 0
+        ln._save_checkpoint(1)
+        # epoch 0 was the live base when save(1) pruned: still here
+        assert os.path.exists(f"{model}_iter-0_part-0")
+        assert ln._wal.base_epoch == 1
+        ln._save_checkpoint(2)
+        # now rooted at 1; epoch 0 released and retired, 1 protected
+        assert not os.path.exists(f"{model}_iter-0_part-0")
+        assert os.path.exists(f"{model}_iter-1_part-0")
+        assert os.path.exists(f"{model}_iter-2_part-0")
+    finally:
+        ln.stop()
+
+
+# ------------------------------------------------- replication + ladder
+
+def test_replicator_push_lag_and_protected_epochs(tmp_path):
+    root, peer = tmp_path / "r", tmp_path / "p"
+    root.mkdir(), peer.mkdir()
+    f1 = str(root / "a.bin")
+    open(f1, "wb").write(os.urandom(1 << 12))
+    from difacto_tpu.obs import gauge
+    lag = gauge("replica_lag_generations", "")
+    r = Replicator([str(peer)], k=1, rank=0, root=str(root))
+    try:
+        r.push([f1], generation=3, epoch=7)
+        assert r.flush(timeout=30)
+        assert r.protected_epochs() == set()  # drained -> released
+        assert open(peer / "a.bin", "rb").read() == \
+            open(f1, "rb").read()
+        assert lag.value(peer="p") == 0  # caught up after the drain
+    finally:
+        r.close()
+
+
+def test_recovery_ladder_wal_rung_mid_window(rcv1_path, tmp_path):
+    """The bench's crash shape, in-process: full WAL-on run, then the
+    last epoch's checkpoint + final model vanish and the newest delta
+    segment is dropped (died mid-window). A fresh learner climbs
+    local -> wal, lands on the surviving verified prefix and stamps the
+    recovery record."""
+    import glob as _glob
+    model = str(tmp_path / "m")
+    args = train_args(rcv1_path, model,
+                      extra=("ckpt_interval=1", "auto_resume=1",
+                             f"wal_flush_batches={FLUSH}"))
+    assert main(args) == 0
+    for f in (_glob.glob(model + "_iter-2_*")
+              + _glob.glob(model + "_part-*")):
+        os.remove(f)
+    d = wal.wal_dir(model)
+    gen = wal.chain_generations(d, 0)[0]
+    chain = wal.chain_segments(d, 0, gen)
+    assert len(chain) >= 2
+    os.remove(chain[-1][1])  # the mid-window segment that never sealed
+
+    ln = SGDLearner()
+    ln.init([tuple(kv.split("=", 1)) for kv in args])
+    try:
+        resumed = ln._try_resume()
+        stamp = json.load(open(model + ".recovery.json"))
+        assert stamp["rungs"] == ["local", "wal"]
+        assert stamp["wal_replay_batches"] > 0
+        assert resumed == stamp["resumed_epoch"]
+        # mid-epoch head: the re-entered epoch fast-forwards the
+        # batches replay already applied
+        assert ln._wal_skip == stamp["skip_batches"] > 0
+        assert stamp["skip_batches"] <= 2 * FLUSH
+    finally:
+        ln.stop()
+
+
+def test_recovery_ladder_peer_rung_disk_loss(rcv1_path, tmp_path):
+    """Disk loss, in-process: the whole local family (checkpoints, WAL
+    chain, meta) is deleted; a fresh learner with ``replica_peers``
+    restores from the peer and resumes — rung 'peer'."""
+    import glob as _glob
+    peer = tmp_path / "peer"
+    peer.mkdir()
+    model = str(tmp_path / "m")
+    args = train_args(rcv1_path, model,
+                      extra=("ckpt_interval=1", "auto_resume=1",
+                             f"wal_flush_batches={FLUSH}",
+                             f"replica_peers={peer}"))
+    assert main(args) == 0
+    ref = _npz_arrays(model + "_iter-2_part-0")
+    import shutil
+    shutil.rmtree(wal.wal_dir(model))
+    for f in _glob.glob(model + "_iter-*") + _glob.glob(model + "_part-*") \
+            + _glob.glob(model + ".meta") + _glob.glob(model + ".recovery*"):
+        os.remove(f)
+
+    ln = SGDLearner()
+    ln.init([tuple(kv.split("=", 1)) for kv in args])
+    try:
+        resumed = ln._try_resume()
+        stamp = json.load(open(model + ".recovery.json"))
+        assert "peer" in stamp["rungs"]
+        assert resumed == 2  # the peer held every interval generation
+        _assert_same_arrays(ref, _npz_arrays(model + "_iter-2_part-0"))
+    finally:
+        ln.stop()
+
+
+# --------------------------------------- the SIGKILL + disk-loss leg
+
+def test_sigkill_mid_window_disk_loss_recovers_bounded(rcv1_path,
+                                                       tmp_path):
+    """Acceptance leg: SIGKILL mid-delta-window (armed ``wal.append:
+    kill`` — the 5th append is epoch 1's second window at step 8), then
+    FULL local disk loss (every model file and the WAL chain deleted).
+    The relaunch restores the family from the peer replica, replays the
+    delta chain on top of the fetched base, fast-forwards the replayed
+    prefix and finishes — with at most one flush window of work re-lost
+    and a final model byte-identical to the unkilled reference run."""
+    peer = tmp_path / "peer"
+    peer.mkdir()
+    model = str(tmp_path / "m")
+    env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
+    args = [sys.executable, "-m", "difacto_tpu"] + train_args(
+        rcv1_path, model,
+        extra=("ckpt_interval=1", "auto_resume=1",
+               f"wal_flush_batches={FLUSH}", f"replica_peers={peer}"))
+    with deadline(240):
+        # appends 1-3 are epoch 0 (incl. boundary); 4 is epoch 1 step
+        # 4; the 5th (epoch 1, step 8) dies before any bytes land
+        env["DIFACTO_FAULTS"] = "wal.append:kill@1:4"
+        p1 = subprocess.run(args, cwd=str(REPO), env=env,
+                            capture_output=True, text=True, timeout=200)
+        assert p1.returncode == -signal.SIGKILL, p1.stderr[-2000:]
+
+        # total disk loss: the model family AND its delta log are gone
+        import glob as _glob
+        import shutil
+        shutil.rmtree(wal.wal_dir(model))
+        for f in _glob.glob(model + "*"):
+            os.remove(f)
+
+        env.pop("DIFACTO_FAULTS")
+        p2 = subprocess.run(args, cwd=str(REPO), env=env,
+                            capture_output=True, text=True, timeout=200)
+        assert p2.returncode == 0, p2.stderr[-2000:]
+
+    stamp = json.load(open(model + ".recovery.json"))
+    assert "peer" in stamp["rungs"] and "wal" in stamp["rungs"]
+    # bounded RPO: the kill hit step 8 of epoch 1 with the step-4
+    # window sealed + replicated — exactly one flush window re-lost
+    assert stamp["head"] == {"epoch": 1, "step": FLUSH,
+                             "boundary": False}
+    assert 0 < stamp["skip_batches"] <= FLUSH
+
+    # byte-identical continuation: the recovered run's final model ==
+    # an unkilled run of the identical config
+    ref_peer = tmp_path / "ref_peer"
+    ref_peer.mkdir()
+    ref = str(tmp_path / "ref")
+    assert main(train_args(
+        rcv1_path, ref,
+        extra=("ckpt_interval=1", "auto_resume=1",
+               f"wal_flush_batches={FLUSH}",
+               f"replica_peers={ref_peer}"))) == 0
+    _assert_same_arrays(_npz_arrays(ref + "_part-0"),
+                        _npz_arrays(model + "_part-0"))
+
+
+# ----------------------------------------------------- obs digest
+
+def test_obs_report_durability_digest(capsys):
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import obs_report
+    finally:
+        sys.path.pop(0)
+    snap = {"counters": {
+        "wal_bytes_total": {"": 4096.0},
+        "wal_replay_batches": {"": 12.0},
+        "wal_replay_dropped_total": {"reason=torn": 1.0},
+        "recovery_rung_total": {"rung=local": 1.0, "rung=wal": 1.0},
+    }, "gauges": {"replica_lag_generations": {"peer=p1": 2.0}}}
+    obs_report.report_durability(snap)
+    out = capsys.readouterr().out
+    assert "durability (WAL + replicas + recovery ladder)" in out
+    for needle in ("wal_bytes_total", "wal_replay_dropped_total{reason=torn}",
+                   "recovery_rung_total{rung=wal}",
+                   "replica_lag_generations{peer=p1}"):
+        assert needle in out, needle

@@ -197,6 +197,47 @@ def report_capacity(snap: dict) -> None:
     print()
 
 
+def report_durability(snap: dict) -> None:
+    """Durability digest (docs/observability.md): the write-ahead
+    delta log's volume and recovery yield (``wal_*``), replica push
+    health and per-peer staleness (``replica_*``), and which recovery
+    rungs resumes actually climbed (``recovery_rung_total{rung}``) —
+    the first read after a chaos run or a real host loss
+    (docs/serving.md "Durability & recovery")."""
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+
+    def _total(section, name):
+        series = section.get(name)
+        if not series:
+            return None
+        return sum(series.values())
+
+    rows = []
+    for name in ("wal_bytes_total", "wal_append_failures_total",
+                 "wal_replay_batches",
+                 "replica_push_failures_total",
+                 "replica_fetch_failures_total",
+                 "replica_scrub_repairs_total"):
+        v = _total(counters, name)
+        if v is not None:
+            rows.append((name, v))
+    for name in ("wal_replay_dropped_total", "recovery_rung_total"):
+        series = counters.get(name, {})
+        for key, v in sorted(series.items()):
+            rows.append((f"{name}{{{key}}}" if key else name, v))
+    series = gauges.get("replica_lag_generations", {})
+    for key, v in sorted(series.items()):
+        rows.append((f"replica_lag_generations{{{key}}}" if key
+                     else "replica_lag_generations", v))
+    if not rows:
+        return
+    print("== durability (WAL + replicas + recovery ladder) ==")
+    for label, v in rows:
+        print(f"  {label:54s} {v:g}")
+    print()
+
+
 def report_counters(snap: dict, top: int = 20) -> None:
     rows = []
     for name, series in snap.get("counters", {}).items():
@@ -250,6 +291,7 @@ def main() -> int:
         report_hists(snap)
         report_fleet(snap)
         report_capacity(snap)
+        report_durability(snap)
         report_gauges(snap)
         report_counters(snap, args.top)
     if args.trace:
